@@ -1,0 +1,49 @@
+"""deequ_tpu: a TPU-native data-quality framework.
+
+"Unit tests for data" with the capabilities of deequ
+(https://github.com/awslabs/deequ), re-designed TPU-first: analyzer states
+are fixed-shape array pytrees, per-batch updates are fused jit'd XLA
+reductions (Pallas kernels for sketch hot loops), rows shard over a
+jax.sharding.Mesh, and state merges are collective semigroup sums.
+
+See SURVEY.md for the structural analysis of the reference this build
+follows.
+"""
+
+from . import config  # noqa: F401  (sets up x64 before anything else)
+from .data import ColumnKind, Dataset, Schema
+from .metrics import (
+    BucketDistribution,
+    BucketValue,
+    Distribution,
+    DistributionValue,
+    DoubleMetric,
+    Entity,
+    Failure,
+    HistogramMetric,
+    KeyedDoubleMetric,
+    KLLMetric,
+    Metric,
+    Success,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BucketDistribution",
+    "BucketValue",
+    "ColumnKind",
+    "Dataset",
+    "Distribution",
+    "DistributionValue",
+    "DoubleMetric",
+    "Entity",
+    "Failure",
+    "HistogramMetric",
+    "KLLMetric",
+    "KeyedDoubleMetric",
+    "Metric",
+    "Schema",
+    "Success",
+    "__version__",
+]

@@ -1,0 +1,286 @@
+"""Analyzer protocol: the core algebra of the engine.
+
+An analyzer is a pair of functions ``computeStateFrom: Data -> S`` and
+``computeMetricFrom: S -> M`` where ``S`` is a commutative-semigroup state
+(reference `analyzers/Analyzer.scala:34-53`). On TPU a state is a pytree of
+fixed-shape jax arrays; ``update`` consumes a whole column *batch* (vectorized,
+never per-row) and ``merge`` is the semigroup sum used for cross-batch,
+cross-device (psum-style collectives) and cross-run (incremental) merges.
+
+Scan-sharing (reference `ScanShareableAnalyzer`, `analyzers/Analyzer.scala:
+169-197`): N analyzers contribute their feature requirements; the runner
+computes the union of features once per batch and calls one fused jit'd update
+for all analyzers — fusion is done by XLA instead of Spark aggregate offsets.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generic, List, Optional, Sequence, Tuple, TypeVar
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import ColumnKind, Schema
+from ..expr import Predicate
+from ..metrics import (
+    DoubleMetric,
+    Entity,
+    Failure,
+    Metric,
+    metric_from_empty,
+    metric_from_failure,
+    metric_from_value,
+)
+from ..exceptions import (
+    MetricCalculationException,
+    NoColumnsSpecifiedException,
+    NoSuchColumnException,
+    NumberOfSpecifiedColumnsException,
+    WrongColumnTypeException,
+    wrap_if_necessary,
+)
+
+S = TypeVar("S")
+M = TypeVar("M", bound=Metric)
+
+
+# ---------------------------------------------------------------------------
+# Feature specs: what a scan-shareable analyzer needs per batch on device.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    """A named, device-resident numeric array derived from the batch.
+
+    ``kind`` selects the host computation (see `runners/features.py`);
+    ``payload`` carries a predicate (str or callable) or regex pattern.
+    ``key`` is the stable string under which the array appears in the
+    features dict handed to the fused jit'd update.
+    """
+
+    kind: str
+    column: Optional[str] = None
+    payload: Any = None
+
+    @property
+    def key(self) -> str:
+        parts = [self.kind]
+        if self.column is not None:
+            parts.append(self.column)
+        if self.payload is not None:
+            parts.append(
+                self.payload if isinstance(self.payload, str) else f"callable:{id(self.payload)}"
+            )
+        return ":".join(parts)
+
+
+def rows_feature() -> FeatureSpec:
+    return FeatureSpec("rows")
+
+
+def numeric_feature(column: str) -> FeatureSpec:
+    return FeatureSpec("num", column)
+
+
+def mask_feature(column: str) -> FeatureSpec:
+    return FeatureSpec("mask", column)
+
+
+def length_feature(column: str) -> FeatureSpec:
+    return FeatureSpec("len", column)
+
+
+def predicate_feature(predicate: Predicate) -> FeatureSpec:
+    return FeatureSpec("pred", None, predicate)
+
+
+def regex_feature(column: str, pattern: str) -> FeatureSpec:
+    return FeatureSpec("match", column, pattern)
+
+
+def hash_feature(column: str) -> FeatureSpec:
+    return FeatureSpec("hash", column)
+
+
+def typeclass_feature(column: str) -> FeatureSpec:
+    return FeatureSpec("type", column)
+
+
+# ---------------------------------------------------------------------------
+# Preconditions (reference `analyzers/Analyzer.scala:285-359`)
+# ---------------------------------------------------------------------------
+
+
+class Preconditions:
+    @staticmethod
+    def has_column(column: str) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            if column not in schema:
+                raise NoSuchColumnException(f"Input data does not include column {column}!")
+
+        return check
+
+    @staticmethod
+    def is_numeric(column: str) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            kind = schema[column].kind
+            if not (kind.is_numeric or kind == ColumnKind.BOOLEAN):
+                raise WrongColumnTypeException(
+                    f"Expected type of column {column} to be numeric, but found {kind.value}!"
+                )
+
+        return check
+
+    @staticmethod
+    def is_string(column: str) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            if schema[column].kind != ColumnKind.STRING:
+                raise WrongColumnTypeException(
+                    f"Expected type of column {column} to be string, but found "
+                    f"{schema[column].kind.value}!"
+                )
+
+        return check
+
+    @staticmethod
+    def is_not_nested(column: str) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            if schema[column].kind == ColumnKind.UNKNOWN:
+                raise WrongColumnTypeException(
+                    f"Unsupported nested column type of column {column}!"
+                )
+
+        return check
+
+    @staticmethod
+    def at_least_one(columns: Sequence[str]) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            if len(columns) == 0:
+                raise NoColumnsSpecifiedException("At least one column needs to be specified!")
+
+        return check
+
+    @staticmethod
+    def exactly_n_columns(columns: Sequence[str], n: int) -> Callable[[Schema], None]:
+        def check(schema: Schema) -> None:
+            if len(columns) != n:
+                raise NumberOfSpecifiedColumnsException(
+                    f"{n} columns have to be specified! Currently, columns contains only "
+                    f"{len(columns)} column(s): {','.join(columns)}!"
+                )
+
+        return check
+
+    @staticmethod
+    def find_first_failing(
+        schema: Schema, conditions: Sequence[Callable[[Schema], None]]
+    ) -> Optional[MetricCalculationException]:
+        for condition in conditions:
+            try:
+                condition(schema)
+            except MetricCalculationException as exc:
+                return exc
+            except Exception as exc:  # noqa: BLE001
+                return wrap_if_necessary(exc)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Analyzer base classes
+# ---------------------------------------------------------------------------
+
+
+class Analyzer(abc.ABC, Generic[S, M]):
+    """Base analyzer. Subclasses are frozen dataclasses, hashable for dedupe
+    (reference dedupes analyzers against repository results,
+    `AnalysisRunner.scala:116-134`)."""
+
+    name: str = "Analyzer"
+
+    @property
+    def instance(self) -> str:
+        return "*"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.DATASET
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return []
+
+    @abc.abstractmethod
+    def compute_metric_from(self, state: Optional[S]) -> M:
+        ...
+
+    def to_failure_metric(self, exception: BaseException) -> DoubleMetric:
+        return metric_from_failure(
+            wrap_if_necessary(exception), self.name, self.instance, self.entity
+        )
+
+    # semigroup ops on host-side states -------------------------------------
+
+    def merge_states(self, a: Optional[S], b: Optional[S]) -> Optional[S]:
+        """None-tolerant semigroup sum (reference `Analyzers.merge`,
+        `analyzers/Analyzer.scala:361-372`)."""
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return self.merge(a, b)
+
+    def merge(self, a: S, b: S) -> S:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class ScanShareableAnalyzer(Analyzer[S, M]):
+    """Analyzer whose state updates fuse into the shared single-pass scan."""
+
+    @abc.abstractmethod
+    def feature_specs(self) -> List[FeatureSpec]:
+        ...
+
+    @abc.abstractmethod
+    def init_state(self) -> S:
+        ...
+
+    @abc.abstractmethod
+    def update(self, state: S, features: Dict[str, jnp.ndarray]) -> S:
+        """Fold one batch into the state. Traced under jit; must be pure,
+        fixed-shape jax ops only."""
+
+    def _row_mask(self, features: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """Valid-row mask combined with this analyzer's where-filter
+        (the `conditionalSelection` analog, reference
+        `analyzers/Analyzer.scala:409-432`)."""
+        mask = features["rows"]
+        where = getattr(self, "where", None)
+        if where is not None:
+            mask = mask & features[predicate_feature(where).key]
+        return mask
+
+
+class StandardScanShareableAnalyzer(ScanShareableAnalyzer[S, DoubleMetric]):
+    """Adds the success/empty/failure DoubleMetric mapping
+    (reference `analyzers/Analyzer.scala:200-226`)."""
+
+    def compute_metric_from(self, state: Optional[S]) -> DoubleMetric:
+        if state is None or self.is_empty(state):
+            return metric_from_empty(self.name, self.instance, self.entity)
+        try:
+            value = self.metric_value(state)
+        except Exception as exc:  # noqa: BLE001
+            return metric_from_failure(wrap_if_necessary(exc), self.name, self.instance, self.entity)
+        if value is None or (isinstance(value, float) and np.isnan(value)):
+            return metric_from_empty(self.name, self.instance, self.entity)
+        return metric_from_value(float(value), self.name, self.instance, self.entity)
+
+    @abc.abstractmethod
+    def metric_value(self, state: S) -> float:
+        ...
+
+    def is_empty(self, state: S) -> bool:
+        """Whether the folded state saw no values at all."""
+        return False

@@ -1,0 +1,371 @@
+"""Frequency/grouping analyzers.
+
+The reference computes one `GROUP BY` per distinct grouping-column set and
+shares the resulting frequency table between all analyzers on that set
+(reference `analyzers/GroupingAnalyzers.scala:29-157`, scheduler sharing at
+`analyzers/runners/AnalysisRunner.scala:259-287`). Here the frequency table
+is an exact host-side group-by (pandas C kernels over the Arrow batch)
+accumulated *in the same single pass* as the device scan — so a verification
+run with grouping analyzers still touches the data once, beating the
+reference's extra jobs.
+
+State semantics (verified against the reference):
+- frequencies exclude rows where any grouping column is null;
+- ``num_rows`` counts ALL rows (`FrequencyBasedAnalyzer.computeFrequencies`,
+  `GroupingAnalyzers.scala:53-80`: numRows = data.count());
+- merge = outer join adding counts (`GroupingAnalyzers.scala:128-148`).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..data import Batch, ColumnKind, Schema
+from ..metrics import (
+    Distribution,
+    DistributionValue,
+    DoubleMetric,
+    Entity,
+    Failure,
+    HistogramMetric,
+    Success,
+    metric_from_empty,
+    metric_from_failure,
+    metric_from_value,
+)
+from ..exceptions import (
+    IllegalAnalyzerParameterException,
+    wrap_if_necessary,
+)
+from .base import Analyzer, Preconditions
+
+COUNT_COL = "count"
+
+
+class FrequenciesAndNumRows:
+    """Host state: group -> count plus total row count
+    (reference `GroupingAnalyzers.scala:128-157`)."""
+
+    def __init__(self, frequencies: pd.Series, num_rows: int, group_columns: Sequence[str]):
+        self.frequencies = frequencies  # index = group keys (tuples for multi-col)
+        self.num_rows = int(num_rows)
+        self.group_columns = list(group_columns)
+
+    def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+        merged = _add_series(self.frequencies, other.frequencies)
+        return FrequenciesAndNumRows(merged, self.num_rows + other.num_rows, self.group_columns)
+
+    @staticmethod
+    def empty(group_columns: Sequence[str]) -> "FrequenciesAndNumRows":
+        return FrequenciesAndNumRows(
+            pd.Series([], dtype=np.int64), 0, group_columns
+        )
+
+    def update(self, batch: Batch) -> "FrequenciesAndNumRows":
+        """Fold one batch of rows into the frequency table."""
+        mask = batch.row_mask
+        cols = {}
+        for name in self.group_columns:
+            col = batch.column(name)
+            mask = mask & col.mask
+            cols[name] = col.values
+        num_rows = self.num_rows + batch.num_rows
+        if not mask.any():
+            return FrequenciesAndNumRows(self.frequencies, num_rows, self.group_columns)
+        frame = pd.DataFrame({n: v[mask] for n, v in cols.items()})
+        counts = frame.groupby(self.group_columns, sort=False, dropna=False).size()
+        if len(self.group_columns) == 1:
+            counts.index = counts.index.get_level_values(0) if isinstance(
+                counts.index, pd.MultiIndex
+            ) else counts.index
+        merged = _add_series(self.frequencies, counts)
+        return FrequenciesAndNumRows(merged, num_rows, self.group_columns)
+
+
+def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
+    """Outer-join add of two count series; tolerates empty operands whose
+    index types don't match the other side's (Range vs MultiIndex)."""
+    if len(a) == 0:
+        return b.astype(np.int64)
+    if len(b) == 0:
+        return a.astype(np.int64)
+    return a.add(b, fill_value=0).astype(np.int64)
+
+
+class GroupingAnalyzer(Analyzer[FrequenciesAndNumRows, DoubleMetric]):
+    """Analyzer computed from a shared frequency table."""
+
+    columns: Sequence[str]
+
+    def grouping_columns(self) -> List[str]:
+        return list(self.columns)
+
+    @property
+    def instance(self) -> str:
+        return ",".join(self.grouping_columns())
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN if len(self.grouping_columns()) == 1 else Entity.MULTICOLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        cols = self.grouping_columns()
+        out: List[Callable[[Schema], None]] = [Preconditions.at_least_one(cols)]
+        for c in cols:
+            out.append(Preconditions.has_column(c))
+            out.append(Preconditions.is_not_nested(c))
+        return out
+
+    def merge(self, a: FrequenciesAndNumRows, b: FrequenciesAndNumRows) -> FrequenciesAndNumRows:
+        return a.sum(b)
+
+
+class ScanShareableFrequencyBasedAnalyzer(GroupingAnalyzer):
+    """Base for analyzers that reduce the frequency table to a double
+    (reference `GroupingAnalyzers.scala:85-123`)."""
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
+        if state is None:
+            return metric_from_empty(self.name, self.instance, self.entity)
+        try:
+            value = self.metric_from_frequencies(state)
+        except Exception as exc:  # noqa: BLE001
+            return metric_from_failure(wrap_if_necessary(exc), self.name, self.instance, self.entity)
+        if value is None or (isinstance(value, float) and math.isnan(value)):
+            return metric_from_empty(self.name, self.instance, self.entity)
+        return metric_from_value(float(value), self.name, self.instance, self.entity)
+
+    @abc.abstractmethod
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        ...
+
+
+@dataclass(frozen=True)
+class Uniqueness(ScanShareableFrequencyBasedAnalyzer):
+    """Fraction of rows whose group occurs exactly once: sum(count==1)/numRows
+    (reference `analyzers/Uniqueness.scala:26-38`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="Uniqueness", init=False)
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", _as_tuple(columns))
+
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        if state.num_rows == 0:
+            return float("nan")
+        return float((state.frequencies == 1).sum()) / state.num_rows
+
+
+@dataclass(frozen=True)
+class Distinctness(ScanShareableFrequencyBasedAnalyzer):
+    """Fraction of distinct groups over rows: sum(count>=1)/numRows
+    (reference `analyzers/Distinctness.scala:29-41`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="Distinctness", init=False)
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", _as_tuple(columns))
+
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        if state.num_rows == 0:
+            return float("nan")
+        return float((state.frequencies >= 1).sum()) / state.num_rows
+
+
+@dataclass(frozen=True)
+class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
+    """sum(count==1) / number of distinct groups
+    (reference `analyzers/UniqueValueRatio.scala:25-44`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="UniqueValueRatio", init=False)
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", _as_tuple(columns))
+
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        num_groups = len(state.frequencies)
+        if num_groups == 0:
+            return float("nan")
+        return float((state.frequencies == 1).sum()) / num_groups
+
+
+@dataclass(frozen=True)
+class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
+    """Number of distinct groups (reference `analyzers/CountDistinct.scala:24-40`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="CountDistinct", init=False)
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", _as_tuple(columns))
+
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        return float(len(state.frequencies))
+
+
+@dataclass(frozen=True)
+class Entropy(ScanShareableFrequencyBasedAnalyzer):
+    """Shannon entropy over the value distribution, with N = total row count:
+    -sum (c/N) ln(c/N) (reference `analyzers/Entropy.scala:28-42`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="Entropy", init=False)
+
+    def __init__(self, column):
+        object.__setattr__(self, "columns", _as_tuple(column))
+
+    def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        n = state.num_rows
+        if n == 0:
+            return float("nan")
+        c = state.frequencies.to_numpy(dtype=np.float64)
+        c = c[c > 0]
+        p = c / n
+        return float(-(p * np.log(p)).sum())
+
+
+@dataclass(frozen=True)
+class MutualInformation(GroupingAnalyzer):
+    """MI of two columns from the joint frequency table
+    (reference `analyzers/MutualInformation.scala:35-103`)."""
+
+    columns: Tuple[str, ...] = ()
+    name: str = field(default="MutualInformation", init=False)
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", _as_tuple(columns))
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.MULTICOLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.exactly_n_columns(self.columns, 2)] + super().preconditions()
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
+        if state is None or len(state.frequencies) == 0:
+            return metric_from_empty(self.name, self.instance, self.entity)
+        try:
+            total = state.num_rows
+            joint = state.frequencies  # MultiIndex (col1, col2) -> count
+            px = joint.groupby(level=0).sum()
+            py = joint.groupby(level=1).sum()
+            pxy = joint.to_numpy(dtype=np.float64) / total
+            px_row = px.loc[joint.index.get_level_values(0)].to_numpy(dtype=np.float64) / total
+            py_row = py.loc[joint.index.get_level_values(1)].to_numpy(dtype=np.float64) / total
+            value = float((pxy * np.log(pxy / (px_row * py_row))).sum())
+        except Exception as exc:  # noqa: BLE001
+            return metric_from_failure(wrap_if_necessary(exc), self.name, self.instance, self.entity)
+        return metric_from_value(value, self.name, self.instance, self.entity)
+
+
+def _as_tuple(columns) -> Tuple[str, ...]:
+    if isinstance(columns, str):
+        return (columns,)
+    return tuple(columns)
+
+
+def _spark_string_cast(value) -> str:
+    """Format a value the way Spark's cast-to-string would (booleans
+    lowercase, floats like '1.0')."""
+    if isinstance(value, (bool, np.bool_)):
+        return "true" if value else "false"
+    if isinstance(value, (float, np.floating)):
+        return repr(float(value)) if not float(value).is_integer() else f"{value:.1f}"
+    if isinstance(value, (int, np.integer)):
+        return str(int(value))
+    return str(value)
+
+
+NULL_FIELD_REPLACEMENT = "NullValue"  # reference `analyzers/Histogram.scala:108`
+MAXIMUM_ALLOWED_DETAIL_BINS = 1000  # reference `analyzers/Histogram.scala:109`
+
+
+@dataclass(frozen=True)
+class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
+    """Exact value histogram of one column: values cast to string, nulls
+    replaced by "NullValue", optional binning function, top-K detail bins by
+    count (reference `analyzers/Histogram.scala:41-116`)."""
+
+    column: str = ""
+    binning_func: Optional[Callable] = None
+    max_detail_bins: int = MAXIMUM_ALLOWED_DETAIL_BINS
+    name: str = field(default="Histogram", init=False)
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        def param_check(schema: Schema) -> None:
+            if self.max_detail_bins > MAXIMUM_ALLOWED_DETAIL_BINS:
+                raise IllegalAnalyzerParameterException(
+                    f"Cannot return histogram values for more than "
+                    f"{MAXIMUM_ALLOWED_DETAIL_BINS} values"
+                )
+
+        return [param_check, Preconditions.has_column(self.column)]
+
+    # host accumulation protocol (driven by the runner's single pass)
+
+    def host_init(self) -> FrequenciesAndNumRows:
+        return FrequenciesAndNumRows.empty([self.column])
+
+    def host_update(self, state: FrequenciesAndNumRows, batch: Batch) -> FrequenciesAndNumRows:
+        col = batch.column(self.column)
+        mask = batch.row_mask
+        values = col.values[mask]
+        present = col.mask[mask]
+        keys = np.empty(len(values), dtype=object)
+        for i in range(len(values)):
+            if not present[i]:
+                keys[i] = NULL_FIELD_REPLACEMENT
+            else:
+                v = values[i]
+                if self.binning_func is not None:
+                    v = self.binning_func(v)
+                keys[i] = _spark_string_cast(v) if v is not None else NULL_FIELD_REPLACEMENT
+        counts = pd.Series(keys).value_counts(sort=False)
+        merged = state.frequencies.add(counts, fill_value=0).astype(np.int64)
+        return FrequenciesAndNumRows(merged, state.num_rows + batch.num_rows, [self.column])
+
+    def merge(self, a: FrequenciesAndNumRows, b: FrequenciesAndNumRows) -> FrequenciesAndNumRows:
+        return a.sum(b)
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> HistogramMetric:
+        if state is None:
+            from ..exceptions import EmptyStateException
+
+            return HistogramMetric(
+                self.entity,
+                self.name,
+                self.instance,
+                Failure(EmptyStateException(f"Empty state for analyzer {self}")),
+                self.column,
+            )
+        try:
+            bin_count = len(state.frequencies)
+            top = state.frequencies.sort_values(ascending=False).head(self.max_detail_bins)
+            values = {
+                str(k): DistributionValue(int(v), int(v) / state.num_rows)
+                for k, v in top.items()
+            }
+            dist = Distribution(values, number_of_bins=bin_count)
+            return HistogramMetric(self.entity, self.name, self.instance, Success(dist), self.column)
+        except Exception as exc:  # noqa: BLE001
+            return HistogramMetric(
+                self.entity, self.name, self.instance, Failure(wrap_if_necessary(exc)), self.column
+            )

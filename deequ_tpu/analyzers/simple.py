@@ -1,0 +1,599 @@
+"""Scan-shareable single-pass reduction analyzers.
+
+Each mirrors a reference analyzer (file:line cited per class) but is a
+vectorized batch reduction: ``update(state, features)`` folds a whole padded
+column batch into the state with pure jax ops, so XLA fuses all analyzers'
+updates into one device program per pass — the TPU analog of deequ's fused
+``data.agg(...)`` scan (reference `analyzers/runners/AnalysisRunner.scala:
+303-318`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ACC_DTYPE, COUNT_DTYPE
+from ..data import Schema
+from ..expr import Predicate
+from ..metrics import (
+    Distribution,
+    DistributionValue,
+    DoubleMetric,
+    Entity,
+    HistogramMetric,
+    Success,
+    metric_from_empty,
+)
+from .base import (
+    FeatureSpec,
+    Preconditions,
+    StandardScanShareableAnalyzer,
+    ScanShareableAnalyzer,
+    length_feature,
+    mask_feature,
+    numeric_feature,
+    predicate_feature,
+    regex_feature,
+    rows_feature,
+    typeclass_feature,
+)
+from .states import (
+    CorrelationState,
+    DataTypeHistogram,
+    MaxState,
+    MeanState,
+    MinState,
+    NumMatches,
+    NumMatchesAndCount,
+    StandardDeviationState,
+    SumState,
+)
+
+
+def _count(mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(mask, dtype=COUNT_DTYPE)
+
+
+def _masked_sum(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(jnp.where(mask, values, 0).astype(ACC_DTYPE))
+
+
+def _masked_min(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.min(jnp.where(mask, values, np.inf).astype(ACC_DTYPE))
+
+
+def _masked_max(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.max(jnp.where(mask, values, -np.inf).astype(ACC_DTYPE))
+
+
+@dataclass(frozen=True)
+class Size(StandardScanShareableAnalyzer[NumMatches]):
+    """Row count (reference `analyzers/Size.scala:23-48`)."""
+
+    where: Optional[Predicate] = None
+    name: str = field(default="Size", init=False)
+
+    @property
+    def instance(self) -> str:
+        return "*"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.DATASET
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature()]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def init_state(self) -> NumMatches:
+        return NumMatches.init()
+
+    def update(self, state: NumMatches, features: Dict[str, jnp.ndarray]) -> NumMatches:
+        return NumMatches(state.num_matches + _count(self._row_mask(features)))
+
+    def merge(self, a: NumMatches, b: NumMatches) -> NumMatches:
+        return a.merge(b)
+
+    def metric_value(self, state: NumMatches) -> float:
+        return state.metric_value()
+
+
+@dataclass(frozen=True)
+class _RatioAnalyzer(StandardScanShareableAnalyzer[NumMatchesAndCount]):
+    """Shared logic for matches/count analyzers."""
+
+    def init_state(self) -> NumMatchesAndCount:
+        return NumMatchesAndCount.init()
+
+    def merge(self, a: NumMatchesAndCount, b: NumMatchesAndCount) -> NumMatchesAndCount:
+        return a.merge(b)
+
+    def metric_value(self, state: NumMatchesAndCount) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state: NumMatchesAndCount) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class Completeness(_RatioAnalyzer):
+    """Fraction of non-null values (reference `analyzers/Completeness.scala:26-46`)."""
+
+    column: str = ""
+    where: Optional[Predicate] = None
+    name: str = field(default="Completeness", init=False)
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.has_column(self.column), Preconditions.is_not_nested(self.column)]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), mask_feature(self.column)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def update(self, state, features):
+        rows = self._row_mask(features)
+        present = features[mask_feature(self.column).key]
+        return NumMatchesAndCount(
+            state.num_matches + _count(rows & present), state.count + _count(rows)
+        )
+
+
+@dataclass(frozen=True)
+class Compliance(_RatioAnalyzer):
+    """Fraction of rows satisfying a predicate
+    (reference `analyzers/Compliance.scala:37-53`). Null predicate results
+    count as non-compliant but stay in the denominator (SQL semantics)."""
+
+    instance_name: str = ""
+    predicate: Predicate = "True"
+    where: Optional[Predicate] = None
+    name: str = field(default="Compliance", init=False)
+
+    @property
+    def instance(self) -> str:
+        return self.instance_name
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), predicate_feature(self.predicate)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def update(self, state, features):
+        rows = self._row_mask(features)
+        matches = features[predicate_feature(self.predicate).key]
+        return NumMatchesAndCount(
+            state.num_matches + _count(rows & matches), state.count + _count(rows)
+        )
+
+
+class Patterns:
+    """Built-in regexes (reference `analyzers/PatternMatch.scala:58-72`)."""
+
+    EMAIL = (
+        r"""(?:[a-z0-9!#$%&'*+/=?^_`{|}~-]+(?:\.[a-z0-9!#$%&'*+/=?^_`{|}~-]+)*"""
+        r"""|"(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21\x23-\x5b\x5d-\x7f]|\\[\x01-\x09\x0b\x0c\x0e-\x7f])*")"""
+        r"""@(?:(?:[a-z0-9](?:[a-z0-9-]*[a-z0-9])?\.)+[a-z0-9](?:[a-z0-9-]*[a-z0-9])?"""
+        r"""|\[(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"""
+        r"""(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?|[a-z0-9-]*[a-z0-9]:"""
+        r"""(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21-\x5a\x53-\x7f]|\\[\x01-\x09\x0b\x0c\x0e-\x7f])+)\])"""
+    )
+    URL = r"""(https?|ftp)://[^\s/$.?#].[^\s]*"""
+    SOCIAL_SECURITY_NUMBER_US = (
+        r"""((?!219-09-9999|078-05-1120)(?!666|000|9\d{2})\d{3}-(?!00)\d{2}-(?!0{4})\d{4})"""
+        r"""|((?!219 09 9999|078 05 1120)(?!666|000|9\d{2})\d{3} (?!00)\d{2} (?!0{4})\d{4})"""
+        r"""|((?!219099999|078051120)(?!666|000|9\d{2})\d{3}(?!00)\d{2}(?!0{4})\d{4})"""
+    )
+    CREDITCARD = (
+        r"""\b(?:3[47]\d{2}([\ \-]?)\d{6}\1\d|(?:(?:4\d|5[1-5]|65)\d{2}|6011)([\ \-]?)\d{4}\2\d{4}\2)\d{4}\b"""
+    )
+
+
+@dataclass(frozen=True)
+class PatternMatch(_RatioAnalyzer):
+    """Fraction of values matching a regex, unanchored search; nulls stay in
+    the denominator (reference `analyzers/PatternMatch.scala:37-55`)."""
+
+    column: str = ""
+    pattern: str = ""
+    where: Optional[Predicate] = None
+    name: str = field(default="PatternMatch", init=False)
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.has_column(self.column), Preconditions.is_string(self.column)]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), regex_feature(self.column, self.pattern)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def update(self, state, features):
+        rows = self._row_mask(features)
+        matches = features[regex_feature(self.column, self.pattern).key]
+        return NumMatchesAndCount(
+            state.num_matches + _count(rows & matches), state.count + _count(rows)
+        )
+
+
+@dataclass(frozen=True)
+class _NumericColumnAnalyzer(StandardScanShareableAnalyzer):
+    """Shared preconditions/features for single numeric-column reductions."""
+
+    column: str = ""
+    where: Optional[Predicate] = None
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.has_column(self.column), Preconditions.is_numeric(self.column)]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), numeric_feature(self.column), mask_feature(self.column)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def _values_and_mask(self, features) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        mask = self._row_mask(features) & features[mask_feature(self.column).key]
+        return features[numeric_feature(self.column).key], mask
+
+
+@dataclass(frozen=True)
+class Mean(_NumericColumnAnalyzer):
+    """(reference `analyzers/Mean.scala:25-54`)."""
+
+    name: str = field(default="Mean", init=False)
+
+    def init_state(self) -> MeanState:
+        return MeanState.init()
+
+    def update(self, state, features):
+        v, mask = self._values_and_mask(features)
+        return MeanState(state.total + _masked_sum(v, mask), state.count + _count(mask))
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class Sum(_NumericColumnAnalyzer):
+    """(reference `analyzers/Sum.scala:25-52`)."""
+
+    name: str = field(default="Sum", init=False)
+
+    def init_state(self) -> SumState:
+        return SumState.init()
+
+    def update(self, state, features):
+        v, mask = self._values_and_mask(features)
+        return SumState(state.total + _masked_sum(v, mask), state.count + _count(mask))
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class Minimum(_NumericColumnAnalyzer):
+    """(reference `analyzers/Minimum.scala:25-53`)."""
+
+    name: str = field(default="Minimum", init=False)
+
+    def init_state(self) -> MinState:
+        return MinState.init()
+
+    def update(self, state, features):
+        v, mask = self._values_and_mask(features)
+        return MinState(jnp.minimum(state.min_value, _masked_min(v, mask)), state.count + _count(mask))
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class Maximum(_NumericColumnAnalyzer):
+    """(reference `analyzers/Maximum.scala:25-53`)."""
+
+    name: str = field(default="Maximum", init=False)
+
+    def init_state(self) -> MaxState:
+        return MaxState.init()
+
+    def update(self, state, features):
+        v, mask = self._values_and_mask(features)
+        return MaxState(jnp.maximum(state.max_value, _masked_max(v, mask)), state.count + _count(mask))
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class _LengthAnalyzer(StandardScanShareableAnalyzer):
+    column: str = ""
+    where: Optional[Predicate] = None
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.has_column(self.column), Preconditions.is_string(self.column)]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), length_feature(self.column), mask_feature(self.column)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def _lengths_and_mask(self, features):
+        mask = self._row_mask(features) & features[mask_feature(self.column).key]
+        return features[length_feature(self.column).key], mask
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return int(state.count) == 0
+
+
+@dataclass(frozen=True)
+class MinLength(_LengthAnalyzer):
+    """Min string length, nulls ignored (reference `analyzers/MinLength.scala:25-41`)."""
+
+    name: str = field(default="MinLength", init=False)
+
+    def init_state(self) -> MinState:
+        return MinState.init()
+
+    def update(self, state, features):
+        lengths, mask = self._lengths_and_mask(features)
+        return MinState(
+            jnp.minimum(state.min_value, _masked_min(lengths, mask)), state.count + _count(mask)
+        )
+
+
+@dataclass(frozen=True)
+class MaxLength(_LengthAnalyzer):
+    """(reference `analyzers/MaxLength.scala:25-41`)."""
+
+    name: str = field(default="MaxLength", init=False)
+
+    def init_state(self) -> MaxState:
+        return MaxState.init()
+
+    def update(self, state, features):
+        lengths, mask = self._lengths_and_mask(features)
+        return MaxState(
+            jnp.maximum(state.max_value, _masked_max(lengths, mask)), state.count + _count(mask)
+        )
+
+
+@dataclass(frozen=True)
+class StandardDeviation(_NumericColumnAnalyzer):
+    """Population stddev via Welford/Chan merges
+    (reference `analyzers/StandardDeviation.scala:25-73`)."""
+
+    name: str = field(default="StandardDeviation", init=False)
+
+    def init_state(self) -> StandardDeviationState:
+        return StandardDeviationState.init()
+
+    def update(self, state, features):
+        v, mask = self._values_and_mask(features)
+        n = jnp.sum(mask, dtype=ACC_DTYPE)
+        safe_n = jnp.where(n == 0, 1.0, n)
+        avg = _masked_sum(v, mask) / safe_n
+        centered = jnp.where(mask, v - avg, 0).astype(ACC_DTYPE)
+        m2 = jnp.sum(centered * centered)
+        batch = StandardDeviationState(n, jnp.where(n == 0, 0.0, avg), jnp.where(n == 0, 0.0, m2))
+        return state.merge(batch)
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return float(state.n) == 0
+
+
+@dataclass(frozen=True)
+class Correlation(StandardScanShareableAnalyzer[CorrelationState]):
+    """Pearson correlation of two columns via mergeable co-moments
+    (reference `analyzers/Correlation.scala:26-105`)."""
+
+    first_column: str = ""
+    second_column: str = ""
+    where: Optional[Predicate] = None
+    name: str = field(default="Correlation", init=False)
+
+    @property
+    def instance(self) -> str:
+        return f"{self.first_column},{self.second_column}"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.MULTICOLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [
+            Preconditions.has_column(self.first_column),
+            Preconditions.is_numeric(self.first_column),
+            Preconditions.has_column(self.second_column),
+            Preconditions.is_numeric(self.second_column),
+        ]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [
+            rows_feature(),
+            numeric_feature(self.first_column),
+            mask_feature(self.first_column),
+            numeric_feature(self.second_column),
+            mask_feature(self.second_column),
+        ]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def init_state(self) -> CorrelationState:
+        return CorrelationState.init()
+
+    def update(self, state, features):
+        x = features[numeric_feature(self.first_column).key]
+        y = features[numeric_feature(self.second_column).key]
+        mask = (
+            self._row_mask(features)
+            & features[mask_feature(self.first_column).key]
+            & features[mask_feature(self.second_column).key]
+        )
+        n = jnp.sum(mask, dtype=ACC_DTYPE)
+        safe_n = jnp.where(n == 0, 1.0, n)
+        x_avg = _masked_sum(x, mask) / safe_n
+        y_avg = _masked_sum(y, mask) / safe_n
+        xc = jnp.where(mask, x - x_avg, 0).astype(ACC_DTYPE)
+        yc = jnp.where(mask, y - y_avg, 0).astype(ACC_DTYPE)
+        batch = CorrelationState(
+            n,
+            jnp.where(n == 0, 0.0, x_avg),
+            jnp.where(n == 0, 0.0, y_avg),
+            jnp.sum(xc * yc),
+            jnp.sum(xc * xc),
+            jnp.sum(yc * yc),
+        )
+        return state.merge(batch)
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def metric_value(self, state) -> float:
+        return state.metric_value()
+
+    def is_empty(self, state) -> bool:
+        return float(state.n) == 0
+
+
+#: order of DataTypeHistogram buckets (reference `analyzers/DataType.scala:32-52`)
+DATA_TYPE_INSTANCES = ("Unknown", "Fractional", "Integral", "Boolean", "String")
+
+
+@dataclass(frozen=True)
+class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
+    """Histogram of inferred value types. Classification per value follows the
+    reference decision order null -> fractional -> integral -> boolean ->
+    string with the reference regexes (reference
+    `analyzers/catalyst/StatefulDataType.scala:36-38`, `analyzers/DataType.scala:32-183`)."""
+
+    column: str = ""
+    where: Optional[Predicate] = None
+    name: str = field(default="DataType", init=False)
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Schema], None]]:
+        return [Preconditions.has_column(self.column), Preconditions.is_not_nested(self.column)]
+
+    def feature_specs(self) -> List[FeatureSpec]:
+        specs = [rows_feature(), typeclass_feature(self.column)]
+        if self.where is not None:
+            specs.append(predicate_feature(self.where))
+        return specs
+
+    def init_state(self) -> DataTypeHistogram:
+        return DataTypeHistogram.init()
+
+    def update(self, state, features):
+        codes = features[typeclass_feature(self.column).key]
+        mask = self._row_mask(features)
+        counts = jnp.zeros(5, dtype=COUNT_DTYPE).at[codes].add(mask.astype(COUNT_DTYPE))
+        return DataTypeHistogram(state.counts + counts)
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def compute_metric_from(self, state: Optional[DataTypeHistogram]) -> HistogramMetric:
+        if state is None:
+            empty = metric_from_empty(self.name, self.instance, self.entity)
+            return HistogramMetric(self.entity, self.name, self.instance, empty.value, self.column)
+        counts = np.asarray(state.counts)
+        total = int(counts.sum())
+        values = {
+            DATA_TYPE_INSTANCES[i]: DistributionValue(
+                int(counts[i]), (int(counts[i]) / total) if total > 0 else 0.0
+            )
+            for i in range(5)
+        }
+        dist = Distribution(values, number_of_bins=5)
+        return HistogramMetric(self.entity, self.name, self.instance, Success(dist), self.column)

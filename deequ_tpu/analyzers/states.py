@@ -1,0 +1,233 @@
+"""Analyzer states: fixed-shape array pytrees with semigroup merge.
+
+Each state mirrors a reference state class (`analyzers/*.scala`) but is a
+flax.struct dataclass of jax scalars/arrays, so it is jit-able, donate-able,
+collectively-mergeable over a mesh, and trivially serializable — the property
+the reference gets from raw agg byte-buffers (`analyzers/StateProvider.scala:
+187-241`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ACC_DTYPE, COUNT_DTYPE
+
+
+def _f(x: float) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=ACC_DTYPE)
+
+
+def _i(x: int) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=COUNT_DTYPE)
+
+
+@flax.struct.dataclass
+class NumMatches:
+    """Row-count state (reference `analyzers/Size.scala:23-29`)."""
+
+    num_matches: jnp.ndarray
+
+    @staticmethod
+    def init() -> "NumMatches":
+        return NumMatches(_i(0))
+
+    def merge(self, other: "NumMatches") -> "NumMatches":
+        return NumMatches(self.num_matches + other.num_matches)
+
+    def metric_value(self) -> float:
+        return float(self.num_matches)
+
+
+@flax.struct.dataclass
+class NumMatchesAndCount:
+    """Ratio state (reference `analyzers/Analyzer.scala:438-449`)."""
+
+    num_matches: jnp.ndarray
+    count: jnp.ndarray
+
+    @staticmethod
+    def init() -> "NumMatchesAndCount":
+        return NumMatchesAndCount(_i(0), _i(0))
+
+    def merge(self, other: "NumMatchesAndCount") -> "NumMatchesAndCount":
+        return NumMatchesAndCount(
+            self.num_matches + other.num_matches, self.count + other.count
+        )
+
+    def metric_value(self) -> float:
+        count = float(self.count)
+        if count == 0:
+            return float("nan")
+        return float(self.num_matches) / count
+
+
+@flax.struct.dataclass
+class MeanState:
+    """(sum, count) (reference `analyzers/Mean.scala:25-35`)."""
+
+    total: jnp.ndarray
+    count: jnp.ndarray
+
+    @staticmethod
+    def init() -> "MeanState":
+        return MeanState(_f(0.0), _i(0))
+
+    def merge(self, other: "MeanState") -> "MeanState":
+        return MeanState(self.total + other.total, self.count + other.count)
+
+    def metric_value(self) -> float:
+        count = float(self.count)
+        if count == 0:
+            return float("nan")
+        return float(self.total) / count
+
+
+@flax.struct.dataclass
+class SumState:
+    """(sum) plus a count used only for emptiness detection
+    (reference `analyzers/Sum.scala:25-33`)."""
+
+    total: jnp.ndarray
+    count: jnp.ndarray
+
+    @staticmethod
+    def init() -> "SumState":
+        return SumState(_f(0.0), _i(0))
+
+    def merge(self, other: "SumState") -> "SumState":
+        return SumState(self.total + other.total, self.count + other.count)
+
+    def metric_value(self) -> float:
+        return float(self.total)
+
+
+@flax.struct.dataclass
+class MinState:
+    """(reference `analyzers/Minimum.scala:25-33`)."""
+
+    min_value: jnp.ndarray
+    count: jnp.ndarray
+
+    @staticmethod
+    def init() -> "MinState":
+        return MinState(_f(np.inf), _i(0))
+
+    def merge(self, other: "MinState") -> "MinState":
+        return MinState(jnp.minimum(self.min_value, other.min_value), self.count + other.count)
+
+    def metric_value(self) -> float:
+        return float(self.min_value)
+
+
+@flax.struct.dataclass
+class MaxState:
+    """(reference `analyzers/Maximum.scala:25-33`)."""
+
+    max_value: jnp.ndarray
+    count: jnp.ndarray
+
+    @staticmethod
+    def init() -> "MaxState":
+        return MaxState(_f(-np.inf), _i(0))
+
+    def merge(self, other: "MaxState") -> "MaxState":
+        return MaxState(jnp.maximum(self.max_value, other.max_value), self.count + other.count)
+
+    def metric_value(self) -> float:
+        return float(self.max_value)
+
+
+@flax.struct.dataclass
+class StandardDeviationState:
+    """Welford/Chan parallel-merge moments (n, avg, m2)
+    (reference `analyzers/StandardDeviation.scala:25-50`)."""
+
+    n: jnp.ndarray
+    avg: jnp.ndarray
+    m2: jnp.ndarray
+
+    @staticmethod
+    def init() -> "StandardDeviationState":
+        return StandardDeviationState(_f(0.0), _f(0.0), _f(0.0))
+
+    def merge(self, other: "StandardDeviationState") -> "StandardDeviationState":
+        n = self.n + other.n
+        safe_n = jnp.where(n == 0, 1.0, n)
+        delta = other.avg - self.avg
+        avg = jnp.where(n == 0, 0.0, (self.avg * self.n + other.avg * other.n) / safe_n)
+        m2 = self.m2 + other.m2 + delta * delta * self.n * other.n / safe_n
+        return StandardDeviationState(n, avg, jnp.where(n == 0, 0.0, m2))
+
+    def metric_value(self) -> float:
+        n = float(self.n)
+        if n == 0:
+            return float("nan")
+        return float(jnp.sqrt(self.m2 / self.n))
+
+
+@flax.struct.dataclass
+class CorrelationState:
+    """Pairwise co-moment accumulators (n, xAvg, yAvg, ck, xMk, yMk)
+    (reference `analyzers/Correlation.scala:26-60`)."""
+
+    n: jnp.ndarray
+    x_avg: jnp.ndarray
+    y_avg: jnp.ndarray
+    ck: jnp.ndarray
+    x_mk: jnp.ndarray
+    y_mk: jnp.ndarray
+
+    @staticmethod
+    def init() -> "CorrelationState":
+        # distinct arrays: a shared buffer would be donated twice under jit
+        return CorrelationState(_f(0.0), _f(0.0), _f(0.0), _f(0.0), _f(0.0), _f(0.0))
+
+    def merge(self, other: "CorrelationState") -> "CorrelationState":
+        n = self.n + other.n
+        safe_n = jnp.where(n == 0, 1.0, n)
+        dx = other.x_avg - self.x_avg
+        dy = other.y_avg - self.y_avg
+        frac = self.n * other.n / safe_n
+        x_avg = jnp.where(n == 0, 0.0, (self.x_avg * self.n + other.x_avg * other.n) / safe_n)
+        y_avg = jnp.where(n == 0, 0.0, (self.y_avg * self.n + other.y_avg * other.n) / safe_n)
+        ck = self.ck + other.ck + dx * dy * frac
+        x_mk = self.x_mk + other.x_mk + dx * dx * frac
+        y_mk = self.y_mk + other.y_mk + dy * dy * frac
+        return CorrelationState(
+            n, x_avg, y_avg, jnp.where(n == 0, 0.0, ck), jnp.where(n == 0, 0.0, x_mk),
+            jnp.where(n == 0, 0.0, y_mk)
+        )
+
+    def metric_value(self) -> float:
+        if float(self.n) == 0:
+            return float("nan")
+        return float(self.ck / jnp.sqrt(self.x_mk * self.y_mk))
+
+
+@flax.struct.dataclass
+class DataTypeHistogram:
+    """Counts of inferred value types [null, fractional, integral, boolean,
+    string] (reference `analyzers/DataType.scala:32-96`)."""
+
+    counts: jnp.ndarray  # int64[5]
+
+    NULL_POS: int = flax.struct.field(pytree_node=False, default=0)
+
+    @staticmethod
+    def init() -> "DataTypeHistogram":
+        return DataTypeHistogram(jnp.zeros(5, dtype=COUNT_DTYPE))
+
+    def merge(self, other: "DataTypeHistogram") -> "DataTypeHistogram":
+        return DataTypeHistogram(self.counts + other.counts)
+
+
+def to_host(state: Any) -> Any:
+    """Bring a device state pytree back as numpy (for persistence/finalize)."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, state)

@@ -1,0 +1,26 @@
+"""Global configuration for the TPU data-quality engine.
+
+The reference (deequ) relies on JVM doubles everywhere; to hold the +-1e-6
+metric-parity target we default to float64 accumulators, which requires
+jax_enable_x64. Set DEEQU_TPU_NO_X64=1 before import to opt out (accumulators
+then fall back to float32 + compensated summation where implemented).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+if not os.environ.get("DEEQU_TPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402  (after x64 setup)
+
+#: dtype used for floating-point accumulator states (sums, moments, ...)
+ACC_DTYPE = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+#: dtype used for integer counters
+COUNT_DTYPE = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+#: default number of rows per device batch fed to the fused update program
+DEFAULT_BATCH_SIZE = 1 << 20

@@ -1,0 +1,303 @@
+"""Tabular data frontend.
+
+The reference operates on Spark DataFrames; here a :class:`Dataset` wraps
+columnar data (pyarrow Table / Parquet files / pandas / dict-of-arrays) and
+yields fixed-size :class:`Batch` objects: per-column numpy value arrays plus
+validity masks. Numeric values are materialized as float64 with NaN at nulls
+so the device program only ever sees fixed-shape numeric arrays; strings stay
+host-side (object arrays) and are turned into numeric *features* (lengths,
+regex masks, hashes, type classes) by the feature frontend
+(`deequ_tpu/runners/features.py`).
+
+Replaces: Spark `DataFrame` + Row null checks (deequ uses `isNotNull` /
+`conditionalSelection`, reference `analyzers/Analyzer.scala:409-432`).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except ImportError:  # pragma: no cover - pyarrow is in the base image
+    pa = None
+    pq = None
+
+
+class ColumnKind(enum.Enum):
+    INTEGRAL = "Integral"
+    FRACTIONAL = "Fractional"
+    BOOLEAN = "Boolean"
+    STRING = "String"
+    TIMESTAMP = "Timestamp"
+    UNKNOWN = "Unknown"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (ColumnKind.INTEGRAL, ColumnKind.FRACTIONAL)
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    kind: ColumnKind
+    nullable: bool = True
+
+
+@dataclass(frozen=True)
+class Schema:
+    columns: Sequence[ColumnSchema]
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "_by_name", {c.name: c for c in self.columns})
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name  # type: ignore[attr-defined]
+
+    def __getitem__(self, name: str) -> ColumnSchema:
+        return self._by_name[name]  # type: ignore[attr-defined]
+
+    @property
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+
+def _kind_of_arrow(t: "pa.DataType") -> ColumnKind:
+    if pa.types.is_boolean(t):
+        return ColumnKind.BOOLEAN
+    if pa.types.is_integer(t):
+        return ColumnKind.INTEGRAL
+    if pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return ColumnKind.FRACTIONAL
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return ColumnKind.STRING
+    if pa.types.is_temporal(t):
+        return ColumnKind.TIMESTAMP
+    return ColumnKind.UNKNOWN
+
+
+def _kind_of_numpy(arr: np.ndarray) -> ColumnKind:
+    if arr.dtype == np.bool_:
+        return ColumnKind.BOOLEAN
+    if np.issubdtype(arr.dtype, np.integer):
+        return ColumnKind.INTEGRAL
+    if np.issubdtype(arr.dtype, np.floating):
+        return ColumnKind.FRACTIONAL
+    if np.issubdtype(arr.dtype, np.datetime64):
+        return ColumnKind.TIMESTAMP
+    return ColumnKind.STRING
+
+
+class Column:
+    """One column slice: raw values + validity mask (True = present)."""
+
+    __slots__ = ("name", "kind", "values", "mask")
+
+    def __init__(self, name: str, kind: ColumnKind, values: np.ndarray, mask: np.ndarray):
+        self.name = name
+        self.kind = kind
+        self.values = values
+        self.mask = mask
+
+    def numeric_f64(self) -> np.ndarray:
+        """float64 view with NaN at nulls — the device-facing representation."""
+        if self.kind == ColumnKind.BOOLEAN:
+            out = np.where(self.mask, self.values.astype(np.float64), np.nan)
+            return out
+        if np.issubdtype(self.values.dtype, np.floating):
+            out = self.values.astype(np.float64, copy=True)
+            out[~self.mask] = np.nan
+            return out
+        if np.issubdtype(self.values.dtype, np.number):
+            out = self.values.astype(np.float64)
+            if not self.mask.all():
+                out = np.where(self.mask, out, np.nan)
+            return out
+        # strings that look numeric: attempt parse (used by the profiler's
+        # cast pass, reference `profiles/ColumnProfiler.scala:346-354`)
+        out = np.full(len(self.values), np.nan, dtype=np.float64)
+        for i in np.flatnonzero(self.mask):
+            try:
+                out[i] = float(self.values[i])
+            except (TypeError, ValueError):
+                pass
+        return out
+
+
+class Batch:
+    """A fixed-size horizontal slice of the dataset.
+
+    ``row_mask`` marks genuine rows (False rows are padding added to keep
+    shapes static across the run, so one XLA program serves every batch).
+    """
+
+    def __init__(self, columns: Dict[str, Column], row_mask: np.ndarray, num_rows: int):
+        self.columns = columns
+        self.row_mask = row_mask
+        self.num_rows = num_rows  # valid rows
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def to_pandas(self):
+        """View for host-side predicate evaluation (Compliance / where)."""
+        import pandas as pd
+
+        data = {}
+        for name, col in self.columns.items():
+            if col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN:
+                data[name] = col.numeric_f64()
+            else:
+                vals = col.values.astype(object, copy=True)
+                vals[~col.mask] = None
+                data[name] = vals
+        return pd.DataFrame(data)
+
+
+ArrayLike = Union[np.ndarray, list]
+
+
+class Dataset:
+    """Columnar dataset with batch iteration.
+
+    Sources: dict of arrays (`from_dict`), pandas (`from_pandas`),
+    pyarrow Table (`from_arrow`), Parquet files (`from_parquet`).
+    """
+
+    def __init__(self, table: "pa.Table"):
+        self._table = table
+        self._schema = Schema(
+            [ColumnSchema(f.name, _kind_of_arrow(f.type), f.nullable) for f in table.schema]
+        )
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_arrow(table: "pa.Table") -> "Dataset":
+        return Dataset(table)
+
+    @staticmethod
+    def from_parquet(path: Union[str, Sequence[str]]) -> "Dataset":
+        return Dataset(pq.read_table(path))
+
+    @staticmethod
+    def from_pandas(df) -> "Dataset":
+        return Dataset(pa.Table.from_pandas(df, preserve_index=False))
+
+    @staticmethod
+    def from_dict(data: Mapping[str, ArrayLike]) -> "Dataset":
+        arrays = {}
+        for name, vals in data.items():
+            arrays[name] = pa.array(vals)
+        return Dataset(pa.table(arrays))
+
+    # -- schema / shape ------------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    @property
+    def arrow(self) -> "pa.Table":
+        return self._table
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def select(self, names: Sequence[str]) -> "Dataset":
+        return Dataset(self._table.select(list(names)))
+
+    def with_column_cast_to_f64(self, name: str) -> "Dataset":
+        """Replace a string column by its parsed-float64 version (profiler
+        pass-2 cast, reference `profiles/ColumnProfiler.scala:346-354`)."""
+        import pyarrow.compute as pc
+
+        col = self._table[name]
+        idx = self._table.schema.get_field_index(name)
+        casted = pc.cast(col, pa.float64(), safe=False)
+        return Dataset(self._table.set_column(idx, name, casted))
+
+    def random_split(self, train_fraction: float, seed: int = 0) -> ("Dataset", "Dataset"):
+        rng = np.random.default_rng(seed)
+        n = self._table.num_rows
+        picks = rng.random(n) < train_fraction
+        idx = np.arange(n)
+        return (
+            Dataset(self._table.take(pa.array(idx[picks]))),
+            Dataset(self._table.take(pa.array(idx[~picks]))),
+        )
+
+    # -- batching ------------------------------------------------------------
+
+    def _materialize_column(self, name: str, chunk: "pa.ChunkedArray") -> Column:
+        kind = self._schema[name].kind
+        arr = chunk.combine_chunks() if isinstance(chunk, pa.ChunkedArray) else chunk
+        n = len(arr)
+        if arr.null_count:
+            mask = np.asarray(arr.is_valid())
+        else:
+            mask = np.ones(n, dtype=bool)
+        if kind.is_numeric:
+            values = arr.to_numpy(zero_copy_only=False)
+        elif kind == ColumnKind.BOOLEAN:
+            values = arr.to_numpy(zero_copy_only=False)
+            if values.dtype == object:
+                values = np.array([bool(v) if v is not None else False for v in values.tolist()])
+        elif kind == ColumnKind.TIMESTAMP:
+            values = arr.to_numpy(zero_copy_only=False)
+        else:
+            values = np.asarray(arr.to_pylist(), dtype=object)
+        return Column(name, kind, values, mask)
+
+    def batches(
+        self,
+        batch_size: int,
+        columns: Optional[Sequence[str]] = None,
+        pad_to_batch_size: bool = True,
+    ) -> Iterator[Batch]:
+        names = list(columns) if columns is not None else self._schema.names
+        table = self._table.select(names) if names != self._schema.names else self._table
+        n = table.num_rows
+        for start in range(0, max(n, 1), batch_size):
+            sl = table.slice(start, batch_size)
+            m = min(batch_size, n - start)  # not sl.num_rows: 0-col tables misreport
+            cols: Dict[str, Column] = {}
+            for name in names:
+                col = self._materialize_column(name, sl[name])
+                if pad_to_batch_size and m < batch_size:
+                    col = _pad_column(col, batch_size)
+                cols[name] = col
+            size = batch_size if pad_to_batch_size else m
+            row_mask = np.zeros(size, dtype=bool)
+            row_mask[:m] = True
+            yield Batch(cols, row_mask, m)
+            if n == 0:
+                break
+
+
+def _pad_column(col: Column, size: int) -> Column:
+    m = len(col.values)
+    pad = size - m
+    if pad <= 0:
+        return col
+    mask = np.zeros(size, dtype=bool)
+    mask[:m] = col.mask
+    if col.values.dtype == object:
+        values = np.empty(size, dtype=object)
+        values[:m] = col.values
+    else:
+        values = np.zeros(size, dtype=col.values.dtype)
+        values[:m] = col.values
+    return Column(col.name, col.kind, values, mask)

@@ -1,0 +1,240 @@
+"""Safe vectorized predicate expressions over column batches.
+
+The reference embeds SQL predicate strings into Catalyst expressions
+(`Compliance`, reference `analyzers/Compliance.scala:37-53`; `where` filters
+via `conditionalSelection`, `analyzers/Analyzer.scala:409-432`). Here
+predicates are Python-syntax strings evaluated vectorized over numpy columns
+with a whitelisted AST interpreter — no Spark, no eval().
+
+Supported syntax::
+
+    "att1 > 3"
+    "att1 >= 2 and att2 < 10"          # elementwise and/or/not
+    "att1 in ('a', 'b')"
+    "att1 is not None"                  # null checks
+    "notnull(att1) | (att2 == 0)"
+    "length(att1) >= 3"
+    "matches(att1, '^[A-Z]+$')"
+
+Null semantics follow SQL-ish 3-valued logic collapsed to False: any
+comparison against a null value yields False.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, Union
+
+import numpy as np
+
+Predicate = Union[str, Callable]
+
+
+class ExpressionError(ValueError):
+    pass
+
+
+def _as_bool(x) -> np.ndarray:
+    arr = np.asarray(x)
+    if arr.dtype == bool:
+        return arr
+    if arr.dtype == object:
+        return np.array([bool(v) if v is not None else False for v in arr], dtype=bool)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.nan_to_num(arr, nan=0.0) != 0
+    return arr != 0
+
+
+def _null_mask(x) -> np.ndarray:
+    arr = np.asarray(x)
+    if arr.dtype == object:
+        return np.array([v is None for v in arr], dtype=bool)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.isnan(arr)
+    return np.zeros(arr.shape, dtype=bool)
+
+
+_FUNCTIONS: Dict[str, Callable] = {
+    "abs": np.abs,
+    "length": lambda x: np.array(
+        [len(v) if v is not None else np.nan for v in np.asarray(x, dtype=object)],
+        dtype=np.float64,  # NaN at nulls so comparisons yield False
+    ),
+    "isnull": _null_mask,
+    "notnull": lambda x: ~_null_mask(x),
+    "startswith": lambda x, p: np.array(
+        [v.startswith(p) if isinstance(v, str) else False for v in np.asarray(x, dtype=object)]
+    ),
+    "endswith": lambda x, p: np.array(
+        [v.endswith(p) if isinstance(v, str) else False for v in np.asarray(x, dtype=object)]
+    ),
+    "contains": lambda x, p: np.array(
+        [p in v if isinstance(v, str) else False for v in np.asarray(x, dtype=object)]
+    ),
+    "matches": lambda x, p: np.array(
+        [bool(re.search(p, v)) if isinstance(v, str) else False for v in np.asarray(x, dtype=object)]
+    ),
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "sqrt": np.sqrt,
+}
+
+def _neq(a, b) -> np.ndarray:
+    # null on either side -> False (3-valued logic collapsed), like NotIn
+    return ~_eq(a, b) & ~_null_mask(a) & ~_null_mask(b)
+
+
+_CMP = {
+    ast.Eq: lambda a, b: _eq(a, b),
+    ast.NotEq: _neq,
+    ast.Lt: lambda a, b: _num_cmp(a, b, np.less),
+    ast.LtE: lambda a, b: _num_cmp(a, b, np.less_equal),
+    ast.Gt: lambda a, b: _num_cmp(a, b, np.greater),
+    ast.GtE: lambda a, b: _num_cmp(a, b, np.greater_equal),
+}
+
+_BIN = {
+    ast.Add: np.add,
+    ast.Sub: np.subtract,
+    ast.Mult: np.multiply,
+    ast.Div: np.divide,
+    ast.Mod: np.mod,
+    ast.Pow: np.power,
+    ast.FloorDiv: np.floor_divide,
+}
+
+
+def _eq(a, b) -> np.ndarray:
+    a_arr, b_arr = np.asarray(a), np.asarray(b)
+    if a_arr.dtype == object or b_arr.dtype == object:
+        out = a_arr == b_arr
+        return _as_bool(out) & ~_null_mask(a) & ~_null_mask(b if b_arr.shape else a)
+    with np.errstate(invalid="ignore"):
+        return np.equal(a, b)
+
+
+def _num_cmp(a, b, op) -> np.ndarray:
+    a_arr, b_arr = np.asarray(a), np.asarray(b)
+    if a_arr.dtype == object or b_arr.dtype == object:
+        null = _null_mask(a_arr) | _null_mask(b_arr)
+        a_f = np.where(null, None, a_arr) if a_arr.dtype == object else a_arr
+        out = np.zeros(np.broadcast_shapes(a_arr.shape, np.shape(b_arr)), dtype=bool)
+        a_b = np.broadcast_to(a_arr, out.shape)
+        b_b = np.broadcast_to(b_arr, out.shape)
+        for i in np.ndindex(out.shape):
+            av, bv = a_b[i], b_b[i]
+            if av is None or bv is None:
+                continue
+            try:
+                out[i] = op(av, bv)
+            except TypeError:
+                pass
+        return out
+    with np.errstate(invalid="ignore"):
+        return op(a, b)
+
+
+class _Evaluator(ast.NodeVisitor):
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        self.columns = columns
+
+    def visit(self, node):  # noqa: D102
+        method = "visit_" + node.__class__.__name__
+        visitor = getattr(self, method, None)
+        if visitor is None:
+            raise ExpressionError(f"unsupported syntax: {node.__class__.__name__}")
+        return visitor(node)
+
+    def visit_Expression(self, node):
+        return self.visit(node.body)
+
+    def visit_Name(self, node):
+        if node.id in self.columns:
+            return self.columns[node.id]
+        if node.id in ("None", "null"):
+            return None
+        raise ExpressionError(f"unknown column: {node.id}")
+
+    def visit_Constant(self, node):
+        return node.value
+
+    def visit_Compare(self, node):
+        left = self.visit(node.left)
+        result = None
+        for op, comparator in zip(node.ops, node.comparators):
+            right = self.visit(comparator)
+            if isinstance(op, (ast.In, ast.NotIn)):
+                if not isinstance(right, (list, tuple, set)):
+                    raise ExpressionError("`in` requires a literal list/tuple")
+                part = np.isin(np.asarray(left), list(right))
+                if isinstance(op, ast.NotIn):
+                    part = ~part & ~_null_mask(left)
+            elif isinstance(op, (ast.Is, ast.IsNot)):
+                if right is not None:
+                    raise ExpressionError("`is` only supports None")
+                part = _null_mask(left)
+                if isinstance(op, ast.IsNot):
+                    part = ~part
+            else:
+                part = _CMP[type(op)](left, right)
+            part = _as_bool(part)
+            result = part if result is None else (result & part)
+            left = right
+        return result
+
+    def visit_BoolOp(self, node):
+        parts = [_as_bool(self.visit(v)) for v in node.values]
+        out = parts[0]
+        for p in parts[1:]:
+            out = (out & p) if isinstance(node.op, ast.And) else (out | p)
+        return out
+
+    def visit_UnaryOp(self, node):
+        val = self.visit(node.operand)
+        if isinstance(node.op, ast.Not):
+            return ~_as_bool(val)
+        if isinstance(node.op, ast.USub):
+            return np.negative(val)
+        if isinstance(node.op, ast.UAdd):
+            return val
+        raise ExpressionError("unsupported unary op")
+
+    def visit_BinOp(self, node):
+        op = _BIN.get(type(node.op))
+        if op is None:
+            raise ExpressionError("unsupported binary op")
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return op(self.visit(node.left), self.visit(node.right))
+
+    def visit_Call(self, node):
+        if not isinstance(node.func, ast.Name) or node.func.id not in _FUNCTIONS:
+            raise ExpressionError("only whitelisted functions allowed")
+        args = [self.visit(a) for a in node.args]
+        return _FUNCTIONS[node.func.id](*args)
+
+    def visit_Tuple(self, node):
+        return tuple(self.visit(e) for e in node.elts)
+
+    def visit_List(self, node):
+        return [self.visit(e) for e in node.elts]
+
+
+def evaluate_predicate(predicate: Predicate, columns: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Evaluate a predicate to a boolean mask of length ``n``.
+
+    ``columns`` maps column name -> numpy array (float64+NaN for numerics,
+    object+None for strings). Callables receive the dict and must return a
+    boolean array.
+    """
+    if callable(predicate):
+        result = predicate(columns)
+    else:
+        tree = ast.parse(predicate, mode="eval")
+        result = _Evaluator(columns).visit(tree)
+    mask = _as_bool(result)
+    if mask.shape == ():
+        mask = np.full(n, bool(mask))
+    if mask.shape != (n,):
+        raise ExpressionError(f"predicate produced shape {mask.shape}, expected ({n},)")
+    return mask

@@ -1,0 +1,27 @@
+"""Native (C++) runtime kernels for host-side hot loops.
+
+The reference's "native tier" is its set of Catalyst ImperativeAggregate /
+UDAF kernels injected into Spark internals (reference
+`analyzers/catalyst/*.scala`). Here the device tier is XLA/Pallas; this
+package holds the *host* native tier: batch string hashing, regex/type
+classification and group-by keying over Arrow buffers, compiled from C++
+(`deequ_tpu/native/src/`) and loaded via ctypes.
+
+Falls back to pure Python (exports = None) when the shared library has not
+been built; build with `python -m deequ_tpu.native.build`.
+"""
+
+from __future__ import annotations
+
+native_xxhash64_strings = None
+native_classify_types = None
+native_string_lengths = None
+
+try:  # pragma: no cover - exercised when the native lib is built
+    from .lib import (  # noqa: F401
+        native_classify_types,
+        native_string_lengths,
+        native_xxhash64_strings,
+    )
+except Exception:  # noqa: BLE001
+    pass

@@ -1,0 +1,29 @@
+from .analysis_runner import AnalysisRunner
+from .builder import Analysis, AnalysisRunBuilder
+from .context import AnalyzerContext
+from .engine import RunMonitor, ScanEngine
+from .exceptions import (
+    EmptyStateException,
+    MetricCalculationException,
+    MetricCalculationPreconditionException,
+    MetricCalculationRuntimeException,
+    NoSuchColumnException,
+    WrongColumnTypeException,
+    wrap_if_necessary,
+)
+
+__all__ = [
+    "Analysis",
+    "AnalysisRunBuilder",
+    "AnalysisRunner",
+    "AnalyzerContext",
+    "EmptyStateException",
+    "MetricCalculationException",
+    "MetricCalculationPreconditionException",
+    "MetricCalculationRuntimeException",
+    "NoSuchColumnException",
+    "RunMonitor",
+    "ScanEngine",
+    "WrongColumnTypeException",
+    "wrap_if_necessary",
+]

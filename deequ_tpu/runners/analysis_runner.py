@@ -1,0 +1,265 @@
+"""AnalysisRunner: the scheduler.
+
+Reference flow (`analyzers/runners/AnalysisRunner.scala:97-203`):
+dedupe vs repository cache -> precondition partition -> split
+{scanning, grouping, KLL} -> fused scan + per-grouping-set frequency jobs ->
+assemble AnalyzerContext -> optional repository save.
+
+TPU-native differences: KLL updates are batched fixed-shape device ops, so
+they join the SAME fused pass as every other scan analyzer (the reference
+needs a dedicated RDD pass, `KLLRunner.scala:87-122`); grouping frequency
+tables accumulate on host during that same pass — a full run touches the
+data exactly once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..analyzers.base import Analyzer, Preconditions, ScanShareableAnalyzer
+from ..analyzers.grouping import (
+    FrequenciesAndNumRows,
+    GroupingAnalyzer,
+    Histogram,
+)
+from ..analyzers.state_provider import StateLoader, StatePersister
+from ..data import Dataset
+from ..metrics import Metric
+from .context import AnalyzerContext
+from .engine import RunMonitor, ScanEngine
+from .exceptions import MetricCalculationException
+
+
+class AnalysisRunner:
+    """Static entry points (reference `AnalysisRunner.onData/run`)."""
+
+    @staticmethod
+    def on_data(data: Dataset) -> "AnalysisRunBuilder":
+        from .builder import AnalysisRunBuilder
+
+        return AnalysisRunBuilder(data)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def do_analysis_run(
+        data: Dataset,
+        analyzers: Sequence[Analyzer],
+        *,
+        aggregate_with: Optional[StateLoader] = None,
+        save_states_with: Optional[StatePersister] = None,
+        metrics_repository: Optional[Any] = None,
+        reuse_existing_results_for_key: Optional[Any] = None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key: Optional[Any] = None,
+        batch_size: Optional[int] = None,
+        monitor: Optional[RunMonitor] = None,
+        sharding: Optional[Any] = None,
+    ) -> AnalyzerContext:
+        if len(analyzers) == 0:
+            return AnalyzerContext.empty()
+
+        # dedupe identical analyzers, preserving order
+        seen = set()
+        unique: List[Analyzer] = []
+        for a in analyzers:
+            if a not in seen:
+                seen.add(a)
+                unique.append(a)
+
+        # reuse existing results from the repository
+        # (reference `AnalysisRunner.scala:115-134`)
+        results_loaded = AnalyzerContext.empty()
+        analyzers_to_run = unique
+        if metrics_repository is not None and reuse_existing_results_for_key is not None:
+            existing = metrics_repository.load_by_key(reuse_existing_results_for_key)
+            if existing is not None:
+                loaded = {
+                    a: m for a, m in existing.metric_map.items() if a in seen
+                }
+                results_loaded = AnalyzerContext(loaded)
+                analyzers_to_run = [a for a in unique if a not in loaded]
+            if fail_if_results_missing and analyzers_to_run:
+                raise MetricCalculationException(
+                    "Could not find all necessary results in the MetricsRepository, "
+                    f"the calculation of the metrics for these analyzers would be needed: "
+                    f"{', '.join(str(a) for a in analyzers_to_run)}"
+                )
+
+        # precondition partition (reference `AnalysisRunner.scala:137-145`)
+        schema = data.schema
+        passed: List[Analyzer] = []
+        failures: Dict[Analyzer, Metric] = {}
+        for a in analyzers_to_run:
+            exc = Preconditions.find_first_failing(schema, a.preconditions())
+            if exc is None:
+                passed.append(a)
+            else:
+                failures[a] = a.to_failure_metric(exc)
+
+        # validate each analyzer's features on a synthetic 1-row batch so a
+        # bad predicate/regex fails only that analyzer, not the shared scan
+        from .features import FeatureBuilder, dry_run_batch
+
+        dry = dry_run_batch(schema)
+        validated = []
+        for a in passed:
+            if isinstance(a, ScanShareableAnalyzer):
+                try:
+                    FeatureBuilder(a.feature_specs()).build(dry)
+                except Exception as exc:  # noqa: BLE001
+                    failures[a] = a.to_failure_metric(exc)
+                    continue
+            validated.append(a)
+        passed = validated
+        precondition_failures = AnalyzerContext(failures)
+
+        # split: device-fused scan / grouping sets / host accumulators
+        scanning = [a for a in passed if isinstance(a, ScanShareableAnalyzer)]
+        grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
+        host_accum = [a for a in passed if hasattr(a, "host_init") and not isinstance(a, GroupingAnalyzer)]
+        others = [
+            a
+            for a in passed
+            if a not in scanning and a not in grouping and a not in host_accum
+        ]
+
+        # one shared pass over the data
+        engine = ScanEngine(scanning, monitor=monitor, sharding=sharding)
+        grouping_sets: Dict[Tuple[str, ...], List[GroupingAnalyzer]] = {}
+        for g in grouping:
+            grouping_sets.setdefault(tuple(g.grouping_columns()), []).append(g)
+
+        host_states: Dict[Any, Any] = {}
+        host_updates: Dict[Any, Any] = {}
+        for cols in grouping_sets:
+            key = ("__grouping__", cols)
+            host_states[key] = FrequenciesAndNumRows.empty(list(cols))
+            host_updates[key] = lambda st, batch: st.update(batch)
+        for a in host_accum:
+            host_states[a] = a.host_init()
+            host_updates[a] = a.host_update
+
+        need_pass = bool(scanning) or bool(host_states)
+        metrics: Dict[Analyzer, Metric] = {}
+        if need_pass:
+            try:
+                columns = _columns_needed(engine, grouping_sets, host_accum, schema)
+                device_states, host_states = engine.run(
+                    data,
+                    batch_size=batch_size,
+                    host_accumulators=host_states,
+                    host_update_fns=host_updates,
+                    columns=columns,
+                )
+            except Exception as exc:  # noqa: BLE001
+                # pass-level failure: every analyzer in the shared scan gets a
+                # failure metric (reference `AnalysisRunner.scala:320-323`)
+                for a in scanning + grouping + host_accum:
+                    metrics[a] = a.to_failure_metric(exc)
+            else:
+                # scanning analyzers: load old state -> merge -> persist -> metric
+                # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
+                for a, state in zip(scanning, device_states):
+                    metrics[a] = _finalize(a, state, aggregate_with, save_states_with)
+                for cols, members in grouping_sets.items():
+                    shared = host_states[("__grouping__", cols)]
+                    for a in members:
+                        metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
+                for a in host_accum:
+                    metrics[a] = _finalize(a, host_states[a], aggregate_with, save_states_with)
+        for a in others:
+            metrics[a] = a.to_failure_metric(
+                MetricCalculationException(f"No execution strategy for analyzer {a}")
+            )
+
+        context = results_loaded + precondition_failures + AnalyzerContext(metrics)
+
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            _save_or_append(metrics_repository, save_or_append_results_with_key, context)
+        return context
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema,
+        analyzers: Sequence[Analyzer],
+        state_loaders: Sequence[StateLoader],
+        *,
+        save_states_with: Optional[StatePersister] = None,
+        metrics_repository: Optional[Any] = None,
+        save_or_append_results_with_key: Optional[Any] = None,
+    ) -> AnalyzerContext:
+        """Compute metrics purely from merged persisted states — no data pass
+        (reference `AnalysisRunner.runOnAggregatedStates`,
+        `AnalysisRunner.scala:385-460`)."""
+        if len(analyzers) == 0 or len(state_loaders) == 0:
+            return AnalyzerContext.empty()
+
+        passed: List[Analyzer] = []
+        failures: Dict[Analyzer, Metric] = {}
+        for a in analyzers:
+            exc = Preconditions.find_first_failing(schema, a.preconditions())
+            if exc is None:
+                passed.append(a)
+            else:
+                failures[a] = a.to_failure_metric(exc)
+
+        metrics: Dict[Analyzer, Metric] = {}
+        for a in passed:
+            merged = None
+            for loader in state_loaders:
+                loaded = loader.load(a)
+                merged = a.merge_states(merged, loaded)
+            if save_states_with is not None and merged is not None:
+                save_states_with.persist(a, merged)
+            try:
+                metrics[a] = a.compute_metric_from(merged)
+            except Exception as exc:  # noqa: BLE001
+                metrics[a] = a.to_failure_metric(exc)
+
+        context = AnalyzerContext(failures) + AnalyzerContext(metrics)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            _save_or_append(metrics_repository, save_or_append_results_with_key, context)
+        return context
+
+
+def _finalize(
+    analyzer: Analyzer,
+    state: Any,
+    aggregate_with: Optional[StateLoader],
+    save_states_with: Optional[StatePersister],
+) -> Metric:
+    try:
+        if aggregate_with is not None:
+            loaded = aggregate_with.load(analyzer)
+            state = analyzer.merge_states(loaded, state)
+        if save_states_with is not None and state is not None:
+            save_states_with.persist(analyzer, state)
+        return analyzer.compute_metric_from(state)
+    except Exception as exc:  # noqa: BLE001
+        return analyzer.to_failure_metric(exc)
+
+
+def _columns_needed(engine: ScanEngine, grouping_sets, host_accum, schema) -> Optional[List[str]]:
+    """Restrict batch materialization to columns any analyzer touches; None
+    (= all columns) when a predicate may reference arbitrary columns."""
+    if any(spec.kind == "pred" for spec in engine.builder.specs.values()):
+        return None
+    cols = set(engine.required_columns())
+    for set_cols in grouping_sets:
+        cols.update(set_cols)
+    for a in host_accum:
+        cols.add(a.column)
+    if not cols:
+        return []
+    return [c for c in schema.names if c in cols]
+
+
+def _save_or_append(repository, key, context: AnalyzerContext) -> None:
+    """Append semantics (reference `AnalysisRunner.scala:205-223`)."""
+    existing = repository.load_by_key(key)
+    combined = (existing or AnalyzerContext.empty()) + context
+    repository.save(key, combined)

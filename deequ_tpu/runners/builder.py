@@ -1,0 +1,114 @@
+"""Fluent run configuration (reference `analyzers/runners/AnalysisRunBuilder.scala:25-186`)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from ..analyzers.base import Analyzer
+from ..data import Dataset
+from .context import AnalyzerContext
+from .engine import RunMonitor
+
+
+class AnalysisRunBuilder:
+    def __init__(self, data: Dataset):
+        self._data = data
+        self._analyzers: List[Analyzer] = []
+        self._aggregate_with = None
+        self._save_states_with = None
+        self._metrics_repository = None
+        self._reuse_key = None
+        self._fail_if_results_missing = False
+        self._save_key = None
+        self._batch_size: Optional[int] = None
+        self._monitor: Optional[RunMonitor] = None
+        self._json_path: Optional[str] = None
+        self._overwrite = False
+
+    def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
+        self._analyzers.append(analyzer)
+        return self
+
+    def add_analyzers(self, analyzers: Sequence[Analyzer]) -> "AnalysisRunBuilder":
+        self._analyzers.extend(analyzers)
+        return self
+
+    def aggregate_with(self, state_loader) -> "AnalysisRunBuilder":
+        self._aggregate_with = state_loader
+        return self
+
+    def save_states_with(self, state_persister) -> "AnalysisRunBuilder":
+        self._save_states_with = state_persister
+        return self
+
+    def with_batch_size(self, batch_size: int) -> "AnalysisRunBuilder":
+        self._batch_size = batch_size
+        return self
+
+    def with_monitor(self, monitor: RunMonitor) -> "AnalysisRunBuilder":
+        self._monitor = monitor
+        return self
+
+    def use_repository(self, repository) -> "AnalysisRunBuilder":
+        self._metrics_repository = repository
+        return self
+
+    def reuse_existing_results_for_key(
+        self, key, fail_if_results_missing: bool = False
+    ) -> "AnalysisRunBuilder":
+        self._reuse_key = key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, key) -> "AnalysisRunBuilder":
+        self._save_key = key
+        return self
+
+    def save_success_metrics_json_to_path(
+        self, path: str, overwrite: bool = False
+    ) -> "AnalysisRunBuilder":
+        self._json_path = path
+        self._overwrite = overwrite
+        return self
+
+    def run(self) -> AnalyzerContext:
+        from .analysis_runner import AnalysisRunner
+
+        context = AnalysisRunner.do_analysis_run(
+            self._data,
+            self._analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+            batch_size=self._batch_size,
+            monitor=self._monitor,
+        )
+        if self._json_path:
+            import os
+
+            if self._overwrite or not os.path.exists(self._json_path):
+                with open(self._json_path, "w", encoding="utf-8") as fh:
+                    fh.write(context.success_metrics_as_json())
+        return context
+
+
+class Analysis:
+    """Immutable list of analyzers + run convenience
+    (reference `analyzers/Analysis.scala:29-63`)."""
+
+    def __init__(self, analyzers: Optional[Sequence[Analyzer]] = None):
+        self.analyzers: List[Analyzer] = list(analyzers or [])
+
+    def add_analyzer(self, analyzer: Analyzer) -> "Analysis":
+        return Analysis(self.analyzers + [analyzer])
+
+    def add_analyzers(self, analyzers: Sequence[Analyzer]) -> "Analysis":
+        return Analysis(self.analyzers + list(analyzers))
+
+    def run(self, data: Dataset, **kwargs) -> AnalyzerContext:
+        from .analysis_runner import AnalysisRunner
+
+        return AnalysisRunner.do_analysis_run(data, self.analyzers, **kwargs)

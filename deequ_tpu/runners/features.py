@@ -1,0 +1,184 @@
+"""Host feature frontend: turns column batches into device-ready numeric
+arrays (the union of all analyzers' FeatureSpecs, computed once per batch).
+
+This is the scan-sharing mechanism: deequ shares one Spark scan between N
+analyzers via fused aggregation columns with row offsets (reference
+`analyzers/runners/AnalysisRunner.scala:303-318`); here N analyzers share one
+host pass + one fused XLA program, and the features dict is their shared
+input. String-typed work (regex, lengths, type inference, hashing) happens
+here, vectorized on host, so the device program stays pure fixed-shape
+numerics.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Sequence
+
+import numpy as np
+
+from ..analyzers.base import FeatureSpec
+from ..data import Batch, ColumnKind
+from ..expr import evaluate_predicate
+from ..ops.hashing import hash_column
+
+# reference regexes (`analyzers/catalyst/StatefulDataType.scala:36-38`);
+# decision order: null -> fractional -> integral -> boolean -> string
+# (`StatefulDataType.update`, same file)
+_FRACTIONAL_RE = re.compile(r"^(-|\+)? ?\d*\.\d*$")
+_INTEGRAL_RE = re.compile(r"^(-|\+)? ?\d*$")
+_BOOLEAN_RE = re.compile(r"^(true|false)$")
+
+TYPE_NULL, TYPE_FRACTIONAL, TYPE_INTEGRAL, TYPE_BOOLEAN, TYPE_STRING = range(5)
+
+
+def classify_type_codes(values: np.ndarray, mask: np.ndarray, kind: ColumnKind) -> np.ndarray:
+    """Per-value inferred-type codes 0..4 (Unknown/Fractional/Integral/
+    Boolean/String). Non-string columns map directly from their kind, which
+    matches the reference's behavior of casting values to strings first
+    (e.g. 1.5 -> "1.5" matches FRACTIONAL)."""
+    n = len(values)
+    if kind == ColumnKind.STRING:
+        from ..native import native_classify_types
+
+        if native_classify_types is not None:
+            return native_classify_types(values, mask)
+        out = np.full(n, TYPE_NULL, dtype=np.int32)
+        for i in range(n):
+            if not mask[i]:
+                continue
+            v = values[i]
+            if v is None:
+                continue
+            if _FRACTIONAL_RE.match(v):
+                out[i] = TYPE_FRACTIONAL
+            elif _INTEGRAL_RE.match(v):
+                out[i] = TYPE_INTEGRAL
+            elif _BOOLEAN_RE.match(v):
+                out[i] = TYPE_BOOLEAN
+            else:
+                out[i] = TYPE_STRING
+        return out
+    if kind == ColumnKind.FRACTIONAL:
+        code = TYPE_FRACTIONAL
+    elif kind == ColumnKind.INTEGRAL:
+        code = TYPE_INTEGRAL
+    elif kind == ColumnKind.BOOLEAN:
+        code = TYPE_BOOLEAN
+    else:
+        code = TYPE_STRING
+    return np.where(mask, np.int32(code), np.int32(TYPE_NULL)).astype(np.int32)
+
+
+def string_lengths(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    from ..native import native_string_lengths
+
+    if native_string_lengths is not None:
+        return native_string_lengths(values, mask)
+    out = np.zeros(len(values), dtype=np.int32)
+    for i in np.flatnonzero(mask):
+        v = values[i]
+        if v is not None:
+            out[i] = len(v)
+    return out
+
+
+def regex_matches(values: np.ndarray, mask: np.ndarray, pattern: str) -> np.ndarray:
+    """Unanchored regex search per value, nulls -> False (the reference uses
+    `regexp_extract(col, pattern, 0) != ""`, `analyzers/PatternMatch.scala:
+    46-52` — note a successful empty-string match also counts as False there,
+    which we reproduce)."""
+    compiled = re.compile(pattern)
+    out = np.zeros(len(values), dtype=bool)
+    for i in np.flatnonzero(mask):
+        v = values[i]
+        if v is None:
+            continue
+        m = compiled.search(str(v))
+        out[i] = bool(m) and m.group(0) != ""
+    return out
+
+
+class FeatureBuilder:
+    """Computes the union of requested features for each batch."""
+
+    def __init__(self, specs: Iterable[FeatureSpec]):
+        # dedupe by key, keep spec objects (payload needed for predicates)
+        self.specs: Dict[str, FeatureSpec] = {}
+        for s in specs:
+            self.specs.setdefault(s.key, s)
+
+    @property
+    def required_columns(self) -> List[str]:
+        # predicates may reference any column — the runner accounts for that
+        # in `_columns_needed`, not here
+        return sorted({s.column for s in self.specs.values() if s.column is not None})
+
+    def build(self, batch: Batch) -> Dict[str, np.ndarray]:
+        features: Dict[str, np.ndarray] = {}
+        pred_columns: Dict[str, np.ndarray] | None = None
+        for key, spec in self.specs.items():
+            if spec.kind == "rows":
+                features[key] = batch.row_mask
+            elif spec.kind == "num":
+                col = batch.column(spec.column)
+                vals = col.numeric_f64()
+                # zero only masked-out positions; genuine NaN/inf values at
+                # valid positions propagate (Spark semantics)
+                features[key] = np.where(col.mask, vals, 0.0)
+            elif spec.kind == "mask":
+                col = batch.column(spec.column)
+                features[key] = col.mask
+            elif spec.kind == "len":
+                col = batch.column(spec.column)
+                features[key] = string_lengths(col.values, col.mask)
+            elif spec.kind == "match":
+                col = batch.column(spec.column)
+                features[key] = regex_matches(col.values, col.mask, spec.payload)
+            elif spec.kind == "type":
+                col = batch.column(spec.column)
+                features[key] = classify_type_codes(col.values, col.mask, col.kind)
+            elif spec.kind == "hash":
+                col = batch.column(spec.column)
+                features[key] = hash_column(col.values, col.mask, col.kind)
+            elif spec.kind == "pred":
+                if pred_columns is None:
+                    pred_columns = _predicate_columns(batch)
+                mask = evaluate_predicate(spec.payload, pred_columns, len(batch.row_mask))
+                features[key] = mask & batch.row_mask
+            else:
+                raise ValueError(f"unknown feature kind {spec.kind}")
+        return features
+
+
+def dry_run_batch(schema) -> Batch:
+    """A synthetic all-null 1-row batch used to validate an analyzer's
+    features (predicate syntax, column refs, regex compilation) before the
+    real pass, so a bad analyzer yields a failure metric instead of killing
+    the shared scan."""
+    from ..data import Column
+
+    columns = {}
+    for cs in schema.columns:
+        mask = np.zeros(1, dtype=bool)
+        if cs.kind.is_numeric or cs.kind == ColumnKind.BOOLEAN:
+            values = np.zeros(1, dtype=np.float64)
+        else:
+            values = np.array([None], dtype=object)
+        columns[cs.name] = Column(cs.name, cs.kind, values, mask)
+    return Batch(columns, np.zeros(1, dtype=bool), 0)
+
+
+def _predicate_columns(batch: Batch) -> Dict[str, np.ndarray]:
+    cols: Dict[str, np.ndarray] = {}
+    for name, col in batch.columns.items():
+        if col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN:
+            cols[name] = col.numeric_f64()
+        else:
+            vals = col.values
+            if vals.dtype != object:
+                vals = vals.astype(object)
+            vals = vals.copy()
+            vals[~col.mask] = None
+            cols[name] = vals
+    return cols

@@ -1,0 +1,278 @@
+"""Per-analyzer golden-value tests vs numpy oracles, incl. null handling —
+the analog of the reference `analyzers/AnalyzerTests.scala` and
+`analyzers/NullHandlingTests.scala`."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    MutualInformation,
+    PatternMatch,
+    Patterns,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+
+
+def run(data, *analyzers, **kwargs):
+    return AnalysisRunner.do_analysis_run(data, list(analyzers), **kwargs)
+
+
+def value_of(context, analyzer):
+    metric = context.metric(analyzer)
+    assert metric is not None, f"no metric for {analyzer}"
+    assert metric.value.is_success, f"failure: {metric.value}"
+    return metric.value.get()
+
+
+class TestSize:
+    def test_size(self, df_missing):
+        assert value_of(run(df_missing, Size()), Size()) == 12.0
+
+    def test_size_with_where(self, df_numeric):
+        a = Size(where="att1 > 3")
+        assert value_of(run(df_numeric, a), a) == 3.0
+
+    def test_size_empty(self):
+        data = Dataset.from_dict({"att1": np.array([], dtype=np.float64)})
+        assert value_of(run(data, Size()), Size()) == 0.0
+
+
+class TestCompleteness:
+    def test_completeness(self, df_missing):
+        ctx = run(df_missing, Completeness("att1"), Completeness("att2"))
+        assert value_of(ctx, Completeness("att1")) == pytest.approx(0.5)
+        assert value_of(ctx, Completeness("att2")) == pytest.approx(0.75)
+
+    def test_completeness_where(self, df_missing):
+        a = Completeness("att2", where="item in ('4', '8', '9')")
+        assert value_of(run(df_missing, a), a) == pytest.approx(1.0 / 3)
+
+    def test_fails_on_missing_column(self, df_missing):
+        ctx = run(df_missing, Completeness("nope"))
+        assert ctx.metric(Completeness("nope")).value.is_failure
+
+
+class TestNumeric:
+    def test_mean(self, df_numeric):
+        assert value_of(run(df_numeric, Mean("att1")), Mean("att1")) == pytest.approx(3.5)
+
+    def test_sum(self, df_numeric):
+        assert value_of(run(df_numeric, Sum("att1")), Sum("att1")) == pytest.approx(21.0)
+
+    def test_min_max(self, df_numeric):
+        ctx = run(df_numeric, Minimum("att1"), Maximum("att1"))
+        assert value_of(ctx, Minimum("att1")) == pytest.approx(1.0)
+        assert value_of(ctx, Maximum("att1")) == pytest.approx(6.0)
+
+    def test_stddev(self, df_numeric):
+        a = StandardDeviation("att1")
+        expected = np.std(np.arange(1, 7))  # population stddev
+        assert value_of(run(df_numeric, a), a) == pytest.approx(expected, rel=1e-12)
+
+    def test_correlation(self, df_numeric):
+        a = Correlation("att2", "att3")
+        x = np.array([0, 0, 0, 5, 6, 7], dtype=float)
+        y = np.array([0, 0, 0, 4, 6, 7], dtype=float)
+        expected = np.corrcoef(x, y)[0, 1]
+        assert value_of(run(df_numeric, a), a) == pytest.approx(expected, rel=1e-12)
+
+    def test_correlation_of_column_with_itself(self, df_numeric):
+        a = Correlation("att1", "att1")
+        assert value_of(run(df_numeric, a), a) == pytest.approx(1.0)
+
+    def test_mean_with_nulls(self):
+        data = Dataset.from_dict({"x": [1.0, None, 3.0, None]})
+        assert value_of(run(data, Mean("x")), Mean("x")) == pytest.approx(2.0)
+
+    def test_mean_empty_column_is_failure(self):
+        data = Dataset.from_dict({"x": [None, None]})
+        import pyarrow as pa
+
+        data = Dataset.from_arrow(pa.table({"x": pa.array([None, None], type=pa.float64())}))
+        ctx = run(data, Mean("x"))
+        assert ctx.metric(Mean("x")).value.is_failure
+
+    def test_fails_on_non_numeric(self, df_full):
+        ctx = run(df_full, Mean("att1"))
+        assert ctx.metric(Mean("att1")).value.is_failure
+
+    def test_where_filter(self, df_numeric):
+        a = Mean("att1", where="att2 > 0")
+        assert value_of(run(df_numeric, a), a) == pytest.approx(5.0)
+
+
+class TestStrings:
+    def test_min_max_length(self):
+        data = Dataset.from_dict({"s": ["a", "bb", "ccc", None]})
+        ctx = run(data, MinLength("s"), MaxLength("s"))
+        assert value_of(ctx, MinLength("s")) == 1.0
+        assert value_of(ctx, MaxLength("s")) == 3.0
+
+    def test_pattern_match(self):
+        data = Dataset.from_dict({"s": ["someone@example.com", "nope", None, "x@y.co"]})
+        a = PatternMatch("s", Patterns.EMAIL)
+        # nulls stay in the denominator (reference PatternMatch semantics)
+        assert value_of(run(data, a), a) == pytest.approx(2.0 / 4)
+
+    def test_compliance(self, df_numeric):
+        a = Compliance("rule1", "att1 > 3")
+        assert value_of(run(df_numeric, a), a) == pytest.approx(3.0 / 6)
+        b = Compliance("rule2", "att1 > 0")
+        assert value_of(run(df_numeric, b), b) == pytest.approx(1.0)
+
+
+class TestDataType:
+    def test_datatype_distribution(self):
+        data = Dataset.from_dict({"s": ["1", "2.0", "true", "foo", None, "3"]})
+        ctx = run(data, DataType("s"))
+        dist = value_of(ctx, DataType("s"))
+        assert dist["Integral"].absolute == 2
+        assert dist["Fractional"].absolute == 1
+        assert dist["Boolean"].absolute == 1
+        assert dist["String"].absolute == 1
+        assert dist["Unknown"].absolute == 1
+        assert dist["Integral"].ratio == pytest.approx(2.0 / 6)
+
+    def test_datatype_on_numeric_column(self, df_numeric):
+        dist = value_of(run(df_numeric, DataType("att1")), DataType("att1"))
+        assert dist["Integral"].absolute == 6
+
+
+class TestGrouping:
+    def test_uniqueness(self, df_missing):
+        ctx = run(df_missing, Uniqueness(["att1"]))
+        # att1 values: a x4, b x2 over 12 rows -> no group of size 1
+        assert value_of(ctx, Uniqueness(["att1"])) == pytest.approx(0.0)
+
+    def test_uniqueness_full(self, df_full):
+        ctx = run(df_full, Uniqueness(["item"]))
+        assert value_of(ctx, Uniqueness(["item"])) == pytest.approx(1.0)
+
+    def test_distinctness(self, df_full):
+        ctx = run(df_full, Distinctness(["att1"]))
+        assert value_of(ctx, Distinctness(["att1"])) == pytest.approx(2.0 / 4)
+
+    def test_unique_value_ratio(self, df_full):
+        # att2: c:1, d:2, f:1 -> 2 unique of 3 distinct
+        a = UniqueValueRatio(["att2"])
+        assert value_of(run(df_full, a), a) == pytest.approx(2.0 / 3)
+
+    def test_count_distinct(self, df_full):
+        a = CountDistinct(["att1"])
+        assert value_of(run(df_full, a), a) == 2.0
+
+    def test_entropy(self, df_full):
+        a = Entropy("att1")
+        p = np.array([3, 1]) / 4.0
+        expected = float(-(p * np.log(p)).sum())
+        assert value_of(run(df_full, a), a) == pytest.approx(expected, rel=1e-12)
+
+    def test_entropy_ignores_nulls_in_numerator_but_not_total(self, df_missing):
+        # att1: a x4, b x2, 6 nulls; N = 12 (reference Entropy uses numRows)
+        a = Entropy("att1")
+        expected = -(4 / 12 * np.log(4 / 12) + 2 / 12 * np.log(2 / 12))
+        assert value_of(run(df_missing, a), a) == pytest.approx(expected, rel=1e-12)
+
+    def test_multi_column_uniqueness(self, df_full):
+        a = Uniqueness(["att1", "att2"])
+        assert value_of(run(df_full, a), a) == pytest.approx(1.0)
+
+    def test_mutual_information(self, df_full):
+        a = MutualInformation(["att1", "att2"])
+        # joint: (a,c):1 (b,d):1 (a,d):1 (a,f):1 over N=4
+        # px: a=3/4 b=1/4 ; py: c=1/4 d=2/4 f=1/4
+        val = 0.0
+        joint = {("a", "c"): 1, ("b", "d"): 1, ("a", "d"): 1, ("a", "f"): 1}
+        px = {"a": 3 / 4, "b": 1 / 4}
+        py = {"c": 1 / 4, "d": 2 / 4, "f": 1 / 4}
+        for (x, y), c in joint.items():
+            pxy = c / 4
+            val += pxy * np.log(pxy / (px[x] * py[y]))
+        assert value_of(run(df_full, a), a) == pytest.approx(val, rel=1e-12)
+
+    def test_mutual_information_wrong_column_count(self, df_full):
+        ctx = run(df_full, MutualInformation(["att1"]))
+        assert ctx.metric(MutualInformation(["att1"])).value.is_failure
+
+
+class TestHistogram:
+    def test_histogram(self, df_full):
+        a = Histogram("att1")
+        dist = value_of(run(df_full, a), a)
+        assert dist.number_of_bins == 2
+        assert dist["a"].absolute == 3
+        assert dist["a"].ratio == pytest.approx(0.75)
+
+    def test_histogram_nulls_become_nullvalue(self, df_missing):
+        a = Histogram("att1")
+        dist = value_of(run(df_missing, a), a)
+        assert dist["NullValue"].absolute == 6
+        assert dist.number_of_bins == 3
+
+    def test_histogram_with_binning(self):
+        data = Dataset.from_dict({"x": [1, 2, 3, 4, 5, 6]})
+        a = Histogram("x", binning_func=lambda v: "low" if v <= 3 else "high")
+        dist = value_of(run(data, a), a)
+        assert dist["low"].absolute == 3
+        assert dist["high"].absolute == 3
+
+    def test_histogram_numeric_formatting(self):
+        data = Dataset.from_dict({"x": [1.0, 1.0, 2.5]})
+        a = Histogram("x")
+        dist = value_of(run(data, a), a)
+        assert dist["1.0"].absolute == 2
+        assert dist["2.5"].absolute == 1
+
+
+class TestBatchInvariance:
+    """Metrics must be identical regardless of batch partitioning — the
+    shard-merge = full-recompute equivalence property (SURVEY §4c)."""
+
+    @pytest.mark.parametrize("batch_size", [1, 2, 3, 7, 64])
+    def test_batch_size_invariance(self, batch_size):
+        rng = np.random.default_rng(0)
+        n = 37
+        data = Dataset.from_dict(
+            {
+                "x": rng.normal(size=n),
+                "y": rng.normal(size=n),
+                "s": [f"v{i % 5}" for i in range(n)],
+            }
+        )
+        analyzers = [
+            Size(),
+            Mean("x"),
+            Sum("x"),
+            Minimum("x"),
+            Maximum("x"),
+            StandardDeviation("x"),
+            Correlation("x", "y"),
+            Completeness("s"),
+            Uniqueness(["s"]),
+            Entropy("s"),
+        ]
+        full = AnalysisRunner.do_analysis_run(data, analyzers, batch_size=64)
+        batched = AnalysisRunner.do_analysis_run(data, analyzers, batch_size=batch_size)
+        for a in analyzers:
+            v1 = full.metric(a).value.get()
+            v2 = batched.metric(a).value.get()
+            assert v1 == pytest.approx(v2, rel=1e-9), f"{a} differs across batchings"

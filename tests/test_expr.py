@@ -1,0 +1,74 @@
+"""Predicate expression null-semantics tests (SQL 3-valued logic collapsed
+to False, matching deequ's Catalyst predicate behavior)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.expr import evaluate_predicate
+
+
+def cols():
+    return {
+        "s": np.array(["a", "b", None, None], dtype=object),
+        "x": np.array([1.0, 2.0, np.nan, 4.0]),
+    }
+
+
+def test_neq_excludes_nulls():
+    mask = evaluate_predicate("s != 'a'", cols(), 4)
+    assert mask.tolist() == [False, True, False, False]
+
+
+def test_eq_excludes_nulls():
+    mask = evaluate_predicate("s == 'a'", cols(), 4)
+    assert mask.tolist() == [True, False, False, False]
+
+
+def test_length_null_is_false_under_comparison():
+    mask = evaluate_predicate("length(s) < 3", cols(), 4)
+    assert mask.tolist() == [True, True, False, False]
+    mask = evaluate_predicate("length(s) >= 1", cols(), 4)
+    assert mask.tolist() == [True, True, False, False]
+
+
+def test_numeric_nan_comparisons_false():
+    mask = evaluate_predicate("x > 0", cols(), 4)
+    assert mask.tolist() == [True, True, False, True]
+    mask = evaluate_predicate("x != 2", cols(), 4)
+    assert mask.tolist() == [True, False, False, True]
+
+
+def test_in_and_not_in():
+    mask = evaluate_predicate("s in ('a', 'b')", cols(), 4)
+    assert mask.tolist() == [True, True, False, False]
+    mask = evaluate_predicate("s not in ('a',)", cols(), 4)
+    assert mask.tolist() == [False, True, False, False]
+
+
+def test_is_null_checks():
+    mask = evaluate_predicate("s is None", cols(), 4)
+    assert mask.tolist() == [False, False, True, True]
+    mask = evaluate_predicate("s is not None", cols(), 4)
+    assert mask.tolist() == [True, True, False, False]
+
+
+def test_boolean_combinators():
+    mask = evaluate_predicate("x >= 2 and s == 'b'", cols(), 4)
+    assert mask.tolist() == [False, True, False, False]
+    mask = evaluate_predicate("not (x > 1)", cols(), 4)
+    # NaN > 1 is False, so `not` flips it to True: null-row caveat documented
+    assert mask.tolist() == [True, False, True, False]
+
+
+def test_inf_and_nan_pass_through_features():
+    """Valid inf/NaN values must reach the device untouched (only nulls are
+    zeroed)."""
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers import Maximum, Mean
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.runners import AnalysisRunner
+
+    data = Dataset.from_arrow(pa.table({"x": pa.array([1.0, float("inf")])}))
+    ctx = AnalysisRunner.do_analysis_run(data, [Maximum("x")])
+    assert ctx.metric(Maximum("x")).value.get() == float("inf")
