@@ -105,6 +105,11 @@ def hash_feature(column: str) -> FeatureSpec:
     return FeatureSpec("hash", column)
 
 
+def hll_feature(column: str) -> FeatureSpec:
+    """(2, B) int32 (register index, leading-zero count) pairs for HLL++."""
+    return FeatureSpec("hll", column)
+
+
 def typeclass_feature(column: str) -> FeatureSpec:
     return FeatureSpec("type", column)
 

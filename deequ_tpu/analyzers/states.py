@@ -226,6 +226,29 @@ class DataTypeHistogram:
         return DataTypeHistogram(self.counts + other.counts)
 
 
+@flax.struct.dataclass
+class ApproxCountDistinctState:
+    """HLL++ registers, unpacked int32[512] (reference packs them into 52
+    longs, `analyzers/ApproxCountDistinct.scala:26-40`; see
+    `deequ_tpu/ops/hll.py` for the packed-format converters)."""
+
+    registers: jnp.ndarray  # int32[512]
+
+    @staticmethod
+    def init() -> "ApproxCountDistinctState":
+        from ..ops.hll import M
+
+        return ApproxCountDistinctState(jnp.zeros(M, dtype=jnp.int32))
+
+    def merge(self, other: "ApproxCountDistinctState") -> "ApproxCountDistinctState":
+        return ApproxCountDistinctState(jnp.maximum(self.registers, other.registers))
+
+    def metric_value(self) -> float:
+        from ..ops.hll import estimate_cardinality
+
+        return estimate_cardinality(np.asarray(self.registers))
+
+
 def to_host(state: Any) -> Any:
     """Bring a device state pytree back as numpy (for persistence/finalize)."""
     import jax

@@ -21,6 +21,7 @@ from ..analyzers.base import FeatureSpec
 from ..data import Batch, ColumnKind
 from ..expr import evaluate_predicate
 from ..ops.hashing import hash_column
+from ..ops.hll import hll_features
 
 # reference regexes (`analyzers/catalyst/StatefulDataType.scala:36-38`);
 # decision order: null -> fractional -> integral -> boolean -> string
@@ -141,6 +142,10 @@ class FeatureBuilder:
             elif spec.kind == "hash":
                 col = batch.column(spec.column)
                 features[key] = hash_column(col.values, col.mask, col.kind)
+            elif spec.kind == "hll":
+                col = batch.column(spec.column)
+                hashes = hash_column(col.values, col.mask, col.kind)
+                features[key] = hll_features(hashes)
             elif spec.kind == "pred":
                 if pred_columns is None:
                     pred_columns = _predicate_columns(batch)

@@ -1,0 +1,125 @@
+"""Sketch analyzer tests: HLL++ accuracy envelopes, merge algebra, packed
+serde round-trips — the analog of the reference
+`analyzers/AnalyzerTests.scala` ApproxCountDistinct cases."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import ApproxCountDistinct, ApproxCountDistinctState
+from deequ_tpu.data import Dataset
+from deequ_tpu.ops import hll
+from deequ_tpu.runners import AnalysisRunner
+
+
+def run(data, *analyzers, **kwargs):
+    return AnalysisRunner.do_analysis_run(data, list(analyzers), **kwargs)
+
+
+def value_of(context, analyzer):
+    metric = context.metric(analyzer)
+    assert metric is not None, f"no metric for {analyzer}"
+    assert metric.value.is_success, f"failure: {metric.value}"
+    return metric.value.get()
+
+
+class TestApproxCountDistinct:
+    def test_small_exactish(self, df_full):
+        # 4 rows, 2 distinct att1 values; at tiny cardinality linear counting
+        # is essentially exact
+        a = ApproxCountDistinct("att1")
+        assert value_of(run(df_full, a), a) == 2.0
+
+    def test_with_nulls(self, df_missing):
+        a = ApproxCountDistinct("att1")
+        assert value_of(run(df_missing, a), a) == 2.0
+
+    def test_with_where(self, df_numeric):
+        a = ApproxCountDistinct("att1", where="att1 <= 3")
+        assert value_of(run(df_numeric, a), a) == 3.0
+
+    def test_error_envelope_strings(self):
+        n = 20000
+        values = np.array([f"value-{i}" for i in range(n)], dtype=object)
+        data = Dataset.from_dict({"col": list(values)})
+        a = ApproxCountDistinct("col")
+        est = value_of(run(data, a), a)
+        # relativeSD = 0.05; allow 3 sigma
+        assert abs(est - n) / n < 0.15
+
+    def test_midrange_uses_bias_corrected_estimator(self):
+        # cardinality between the linear-counting threshold (400 for p=9) and
+        # 5m: must go through the bias-corrected raw estimator, not linear
+        # counting
+        n = 1000
+        data = Dataset.from_dict({"col": [f"v{i}" for i in range(n)]})
+        a = ApproxCountDistinct("col")
+        est = value_of(run(data, a), a)
+        assert abs(est - n) / n < 0.15
+
+    def test_error_envelope_ints(self):
+        rng = np.random.default_rng(7)
+        vals = rng.integers(0, 50000, size=200000)
+        data = Dataset.from_dict({"col": vals})
+        exact = len(np.unique(vals))
+        a = ApproxCountDistinct("col")
+        est = value_of(run(data, a), a)
+        assert abs(est - exact) / exact < 0.15
+
+    def test_batched_equals_single_pass(self):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(0, 5000, size=30000)
+        data = Dataset.from_dict({"col": vals})
+        a = ApproxCountDistinct("col")
+        full = value_of(run(data, a), a)
+        batched = value_of(run(data, a, batch_size=1024), a)
+        assert full == batched
+
+    def test_merge_algebra(self):
+        rng = np.random.default_rng(11)
+        vals = rng.integers(0, 3000, size=10000)
+        d_all = Dataset.from_dict({"col": vals})
+        d1 = Dataset.from_dict({"col": vals[:4000]})
+        d2 = Dataset.from_dict({"col": vals[4000:]})
+        a = ApproxCountDistinct("col")
+
+        from deequ_tpu.analyzers import InMemoryStateProvider
+
+        s1, s2 = InMemoryStateProvider(), InMemoryStateProvider()
+        run(d1, a, save_states_with=s1)
+        run(d2, a, save_states_with=s2)
+        merged = a.merge_states(s1.load(a), s2.load(a))
+        assert a.compute_metric_from(merged).value.get() == value_of(run(d_all, a), a)
+
+    def test_empty_is_zero(self):
+        data = Dataset.from_dict({"col": np.array([], dtype=np.int64)})
+        a = ApproxCountDistinct("col")
+        assert value_of(run(data, a), a) == 0.0
+
+
+class TestHLLInternals:
+    def test_clz64(self):
+        xs = np.array([1, 2, 1 << 63, (1 << 64) - 1, 256, 1 << 32], dtype=np.uint64)
+        expected = [63, 62, 0, 0, 55, 31]
+        assert list(hll._clz64(xs)) == expected
+
+    def test_word_packing_roundtrip(self):
+        rng = np.random.default_rng(0)
+        regs = rng.integers(0, 56, size=hll.M).astype(np.int32)
+        words = hll.registers_to_words(regs)
+        assert words.shape == (hll.NUM_WORDS,)
+        back = hll.words_to_registers(words)
+        np.testing.assert_array_equal(regs, back)
+
+    def test_feature_math_matches_reference_semantics(self):
+        # idx = top 9 bits; pw = clz((x << 9) | 256) + 1
+        h = np.array([0, (1 << 64) - 1, 1 << 55], dtype=np.uint64)
+        pairs = hll.hll_features(h)
+        idx, pw = pairs[0], pairs[1]
+        assert list(idx) == [0, 511, 1]
+        # x=0: w = 256 -> clz = 55 -> pw = 56
+        assert pw[0] == 56
+        # all ones: w starts with 1 -> clz = 0 -> pw = 1
+        assert pw[1] == 1
+
+    def test_estimate_zero(self):
+        assert hll.estimate_cardinality(np.zeros(hll.M, dtype=np.int32)) == 0.0
