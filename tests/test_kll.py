@@ -1,0 +1,216 @@
+"""KLL sketch tests: probabilistic rank-error bounds (the reference
+`KLL/KLLProbTest.scala` analog), merge = recompute algebra, bucket
+distribution semantics, ApproxQuantile(s) accuracy."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deequ_tpu.analyzers import (
+    ApproxQuantile,
+    ApproxQuantiles,
+    KLLParameters,
+    KLLSketch,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.ops.kll import kll_init, kll_merge, kll_update
+from deequ_tpu.ops.kll_host import HostKLL
+from deequ_tpu.runners import AnalysisRunner
+
+
+def run(data, *analyzers, **kwargs):
+    return AnalysisRunner.do_analysis_run(data, list(analyzers), **kwargs)
+
+
+def value_of(context, analyzer):
+    metric = context.metric(analyzer)
+    assert metric is not None, f"no metric for {analyzer}"
+    assert metric.value.is_success, f"failure: {metric.value}"
+    return metric.value.get()
+
+
+def fold(values, k=2048, batch=4096):
+    state = kll_init(k)
+    values = np.asarray(values, dtype=np.float64)
+    for start in range(0, len(values), batch):
+        chunk = values[start : start + batch]
+        padded = np.full(batch, 0.0)
+        mask = np.zeros(batch, dtype=bool)
+        padded[: len(chunk)] = chunk
+        mask[: len(chunk)] = True
+        state = kll_update(state, jnp.asarray(padded), jnp.asarray(mask))
+    return state
+
+
+class TestKLLKernel:
+    def test_exact_when_small(self):
+        vals = np.arange(100, dtype=np.float64)
+        state = fold(vals, k=256)
+        sketch = HostKLL.from_state(state)
+        assert sketch.total_weight == 100
+        assert sketch.quantile(0.0) == 0.0
+        assert sketch.quantile(1.0) == 99.0
+        assert abs(sketch.quantile(0.5) - 49.0) <= 1.0
+
+    def test_count_min_max(self):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(0, 1, 50000)
+        state = fold(vals)
+        assert int(state.count) == 50000
+        assert float(state.g_min) == vals.min()
+        assert float(state.g_max) == vals.max()
+
+    @pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal"])
+    def test_rank_error_bound(self, dist):
+        rng = np.random.default_rng(42)
+        n = 200000
+        if dist == "uniform":
+            vals = rng.uniform(0, 1, n)
+        elif dist == "normal":
+            vals = rng.normal(0, 1, n)
+        else:
+            vals = rng.lognormal(0, 1, n)
+        state = fold(vals, k=2048, batch=8192)
+        sketch = HostKLL.from_state(state)
+        svals = np.sort(vals)
+        max_err = 0.0
+        for q in [0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99]:
+            est = sketch.quantile(q)
+            # true rank of the estimate
+            true_rank = np.searchsorted(svals, est, side="right") / n
+            max_err = max(max_err, abs(true_rank - q))
+        # k=2048 should give well under 1% rank error
+        assert max_err < 0.01, f"max rank error {max_err} for {dist}"
+
+    def test_merge_matches_union(self):
+        rng = np.random.default_rng(1)
+        a_vals = rng.normal(0, 1, 30000)
+        b_vals = rng.normal(5, 2, 30000)
+        sa = fold(a_vals, k=1024)
+        sb = fold(b_vals, k=1024)
+        merged = kll_merge(sa, sb)
+        assert int(merged.count) == 60000
+        union = np.sort(np.concatenate([a_vals, b_vals]))
+        sketch = HostKLL.from_state(merged)
+        for q in [0.1, 0.5, 0.9]:
+            est = sketch.quantile(q)
+            true_rank = np.searchsorted(union, est, side="right") / 60000
+            assert abs(true_rank - q) < 0.02
+
+    def test_weights_approximate_count(self):
+        rng = np.random.default_rng(2)
+        vals = rng.uniform(0, 1, 100000)
+        state = fold(vals, k=1024, batch=4096)
+        sketch = HostKLL.from_state(state)
+        # total item weight tracks the exact count within subsampling slack
+        assert abs(sketch.total_weight - 100000) / 100000 < 0.02
+
+    def test_nan_excluded(self):
+        vals = np.array([1.0, np.nan, 2.0, np.nan, 3.0])
+        state = fold(vals, k=256)
+        assert int(state.count) == 3
+        assert float(state.g_max) == 3.0
+
+
+class TestKLLSketchAnalyzer:
+    def test_bucket_distribution(self):
+        vals = np.concatenate([np.zeros(50), np.ones(50) * 10])
+        data = Dataset.from_dict({"col": vals})
+        a = KLLSketch("col", KLLParameters(1024, 0.64, 2))
+        dist = value_of(run(data, a), a)
+        assert len(dist.buckets) == 2
+        assert dist.buckets[0].low_value == 0.0
+        assert dist.buckets[-1].high_value == 10.0
+        assert dist.buckets[0].count == 50
+        assert dist.buckets[1].count == 50
+        assert sum(b.count for b in dist.buckets) == 100
+
+    def test_default_params(self, df_numeric):
+        a = KLLSketch("att1")
+        dist = value_of(run(df_numeric, a), a)
+        assert dist.parameters == [0.64, 2048.0]
+        assert len(dist.buckets) == 100
+        assert sum(b.count for b in dist.buckets) == 6
+
+    def test_compute_percentiles_roundtrip(self, df_numeric):
+        a = KLLSketch("att1")
+        dist = value_of(run(df_numeric, a), a)
+        pcts = dist.compute_percentiles()
+        assert len(pcts) == 100
+        assert pcts[0] == 1.0
+        assert pcts[-1] == 6.0
+
+    def test_too_many_buckets_fails(self, df_numeric):
+        a = KLLSketch("att1", KLLParameters(1024, 0.64, 101))
+        m = run(df_numeric, a).metric(a)
+        assert m.value.is_failure
+
+    def test_non_numeric_fails(self, df_full):
+        a = KLLSketch("att1")
+        m = run(df_full, a).metric(a)
+        assert m.value.is_failure
+
+    def test_incremental_merge_via_states(self):
+        from deequ_tpu.analyzers import InMemoryStateProvider
+
+        rng = np.random.default_rng(5)
+        vals = rng.normal(0, 1, 20000)
+        d1 = Dataset.from_dict({"col": vals[:10000]})
+        d2 = Dataset.from_dict({"col": vals[10000:]})
+        a = KLLSketch("col")
+        s1, s2 = InMemoryStateProvider(), InMemoryStateProvider()
+        run(d1, a, save_states_with=s1)
+        run(d2, a, save_states_with=s2)
+        merged = a.merge_states(s1.load(a), s2.load(a))
+        dist = a.compute_metric_from(merged).value.get()
+        assert sum(b.count for b in dist.buckets) == pytest.approx(20000, rel=0.02)
+
+
+class TestApproxQuantile:
+    def test_median_exactish(self):
+        data = Dataset.from_dict({"col": np.arange(1, 1001, dtype=np.float64)})
+        a = ApproxQuantile("col", 0.5)
+        est = value_of(run(data, a), a)
+        assert abs(est - 500) <= 10
+
+    def test_error_bound(self):
+        rng = np.random.default_rng(9)
+        vals = rng.normal(100, 15, 100000)
+        data = Dataset.from_dict({"col": vals})
+        svals = np.sort(vals)
+        for q in [0.1, 0.5, 0.9]:
+            a = ApproxQuantile("col", q, relative_error=0.01)
+            est = value_of(run(data, a), a)
+            true_rank = np.searchsorted(svals, est, side="right") / len(vals)
+            assert abs(true_rank - q) <= 0.01
+
+    def test_invalid_quantile(self, df_numeric):
+        a = ApproxQuantile("att1", 1.5)
+        assert run(df_numeric, a).metric(a).value.is_failure
+
+    def test_where(self, df_numeric):
+        a = ApproxQuantile("att1", 0.5, where="att1 <= 3")
+        est = value_of(run(df_numeric, a), a)
+        assert est in (1.0, 2.0)
+
+    def test_empty(self):
+        data = Dataset.from_dict({"col": np.array([], dtype=np.float64)})
+        a = ApproxQuantile("col", 0.5)
+        assert run(data, a).metric(a).value.is_failure
+
+
+class TestApproxQuantiles:
+    def test_keyed_metric(self):
+        data = Dataset.from_dict({"col": np.arange(1, 101, dtype=np.float64)})
+        a = ApproxQuantiles("col", (0.25, 0.5, 0.75))
+        vals = value_of(run(data, a), a)
+        assert set(vals) == {"0.25", "0.5", "0.75"}
+        assert abs(vals["0.5"] - 50) <= 2
+
+    def test_flatten(self):
+        data = Dataset.from_dict({"col": np.arange(1, 101, dtype=np.float64)})
+        a = ApproxQuantiles("col", (0.5,))
+        metric = run(data, a).metric(a)
+        flat = metric.flatten()
+        assert flat[0].name == "ApproxQuantiles-0.5"
