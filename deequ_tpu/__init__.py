@@ -11,7 +11,21 @@ follows.
 """
 
 from . import config  # noqa: F401  (sets up x64 before anything else)
+from .checks import Check, CheckLevel, CheckStatus
 from .data import ColumnKind, Dataset, Schema
+from .repository import (
+    AnalysisResult,
+    FileSystemMetricsRepository,
+    InMemoryMetricsRepository,
+    MetricsRepository,
+    ResultKey,
+)
+from .verification import (
+    AnomalyCheckConfig,
+    VerificationResult,
+    VerificationRunBuilder,
+    VerificationSuite,
+)
 from .metrics import (
     BucketDistribution,
     BucketValue,
@@ -30,7 +44,19 @@ from .metrics import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "AnalysisResult",
+    "AnomalyCheckConfig",
+    "FileSystemMetricsRepository",
+    "InMemoryMetricsRepository",
+    "MetricsRepository",
+    "ResultKey",
     "BucketDistribution",
+    "Check",
+    "CheckLevel",
+    "CheckStatus",
+    "VerificationResult",
+    "VerificationRunBuilder",
+    "VerificationSuite",
     "BucketValue",
     "ColumnKind",
     "Dataset",

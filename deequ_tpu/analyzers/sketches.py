@@ -296,9 +296,6 @@ class ApproxQuantile(_KLLBackedAnalyzer, StandardScanShareableAnalyzer[KLLSketch
 
         return [param_checks] + super().preconditions()
 
-    def compute_metric_from(self, state):
-        return StandardScanShareableAnalyzer.compute_metric_from(self, state)
-
     def metric_value(self, state: KLLSketchState) -> float:
         return HostKLL.from_state(state).quantile(self.quantile)
 

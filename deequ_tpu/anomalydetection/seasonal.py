@@ -1,0 +1,139 @@
+"""Additive Holt-Winters seasonal anomaly detection with L-BFGS-B parameter
+fitting (reference `anomalydetection/seasonal/HoltWinters.scala:63-249`,
+which uses breeze's LBFGSB; here scipy's)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from . import Anomaly, AnomalyDetectionStrategy
+
+
+class SeriesSeasonality(enum.Enum):
+    WEEKLY = "Weekly"
+    YEARLY = "Yearly"
+
+
+class MetricInterval(enum.Enum):
+    DAILY = "Daily"
+    MONTHLY = "Monthly"
+
+
+@dataclass(frozen=True)
+class ModelResults:
+    forecasts: List[float]
+    level: List[float]
+    trend: List[float]
+    seasonality: List[float]
+    residuals: List[float]
+
+
+def additive_holt_winters(
+    series: Sequence[float],
+    periodicity: int,
+    number_of_points_to_forecast: int,
+    alpha: float,
+    beta: float,
+    gamma: float,
+) -> ModelResults:
+    """(reference `HoltWinters.scala:76-124` — same recurrences)."""
+    series = list(series)
+    m = periodicity
+    first_period_sum = sum(series[:m])
+    second_period_sum = sum(series[m : 2 * m])
+    level = [first_period_sum / m]
+    trend = [(second_period_sum - first_period_sum) / (m * m)]
+    seasonality = [x - level[0] for x in series[:m]]
+    y = [level[0] + trend[0] + seasonality[0]]
+    big_y = list(series)
+    for t in range(len(series) + number_of_points_to_forecast):
+        if t >= len(series):
+            big_y.append(level[-1] + trend[-1] + seasonality[len(seasonality) - m])
+        level.append(alpha * (big_y[t] - seasonality[t]) + (1 - alpha) * (level[t] + trend[t]))
+        trend.append(beta * (level[t + 1] - level[t]) + (1 - beta) * trend[t])
+        seasonality.append(
+            gamma * (big_y[t] - level[t] - trend[t]) + (1 - gamma) * seasonality[t]
+        )
+        y.append(level[t + 1] + trend[t + 1] + seasonality[t + 1])
+    residuals = [series_value - forecast for forecast, series_value in zip(y, series)]
+    forecasted = big_y[len(series) :]
+    return ModelResults(forecasted, level, trend, seasonality, residuals)
+
+
+class HoltWinters(AnomalyDetectionStrategy):
+    """(reference `HoltWinters.scala:63-249`; periodicity table `:70-73`)."""
+
+    def __init__(self, metrics_interval: MetricInterval, seasonality: SeriesSeasonality):
+        table = {
+            (SeriesSeasonality.WEEKLY, MetricInterval.DAILY): 7,
+            (SeriesSeasonality.YEARLY, MetricInterval.MONTHLY): 12,
+        }
+        key = (seasonality, metrics_interval)
+        if key not in table:
+            raise ValueError(
+                "Only (Weekly seasonality, Daily interval) and (Yearly, Monthly) are supported"
+            )
+        self.series_periodicity = table[key]
+
+    def _fit(self, series: Sequence[float], num_forecast: int) -> Tuple[float, float, float]:
+        from scipy.optimize import minimize
+
+        m = self.series_periodicity
+
+        def objective(x: np.ndarray) -> float:
+            results = additive_holt_winters(series, m, num_forecast, x[0], x[1], x[2])
+            return float(sum(r * r for r in results.residuals))
+
+        res = minimize(
+            objective,
+            x0=np.array([0.3, 0.1, 0.1]),
+            method="L-BFGS-B",
+            bounds=[(0.0, 1.0)] * 3,
+        )
+        return float(res.x[0]), float(res.x[1]), float(res.x[2])
+
+    def detect(self, data_series, search_interval=(0, 2**31 - 1)):
+        if len(data_series) == 0:
+            raise ValueError("Provided data series is empty")
+        start, end = search_interval
+        if start >= end:
+            raise ValueError("Start must be before end")
+        if start < 0 or end < 0:
+            raise ValueError("The search interval needs to be strictly positive")
+        if start < self.series_periodicity * 2:
+            raise ValueError("Need at least two full cycles of data to estimate model")
+
+        if start >= len(data_series):
+            num_forecast = 1
+        else:
+            num_forecast = min(end, len(data_series)) - start
+
+        training = list(data_series[:start])
+        alpha, beta, gamma = self._fit(training, num_forecast)
+        results = additive_holt_winters(
+            training, self.series_periodicity, num_forecast, alpha, beta, gamma
+        )
+        abs_residuals = np.abs(np.asarray(results.residuals))
+        residual_sd = float(np.std(abs_residuals, ddof=1)) if len(abs_residuals) > 1 else 0.0
+
+        out = []
+        test_series = data_series[start:]
+        for detection_index, (observed, forecast) in enumerate(
+            zip(test_series, results.forecasts)
+        ):
+            if abs(observed - forecast) > 1.96 * residual_sd:
+                out.append(
+                    (
+                        detection_index + start,
+                        Anomaly(
+                            observed,
+                            1.0,
+                            f"Forecasted {forecast} for observed value {observed}",
+                        ),
+                    )
+                )
+        return out

@@ -1,0 +1,280 @@
+"""Anomaly detection strategies (reference `anomalydetection/*.scala`)."""
+
+from __future__ import annotations
+
+import math
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import Anomaly, AnomalyDetectionStrategy
+
+# finite sentinels (the reference uses Double.MinValue/MaxValue): a factor
+# of MAX times stdDev 0 must stay 0, never NaN as inf*0 would be
+_NEG_INF = -sys.float_info.max
+_POS_INF = sys.float_info.max
+
+
+@dataclass(frozen=True)
+class SimpleThresholdStrategy(AnomalyDetectionStrategy):
+    """Flags values outside [lower_bound, upper_bound]
+    (reference `anomalydetection/SimpleThresholdStrategy.scala`)."""
+
+    upper_bound: float
+    lower_bound: float = _NEG_INF
+
+    def __post_init__(self):
+        if self.lower_bound > self.upper_bound:
+            raise ValueError("The lower bound must be smaller or equal to the upper bound.")
+
+    def detect(self, data_series, search_interval):
+        start, end = search_interval
+        if start > end:
+            raise ValueError("The start of the interval can't be larger than the end.")
+        out = []
+        for index in range(start, min(end, len(data_series))):
+            value = data_series[index]
+            if value < self.lower_bound or value > self.upper_bound:
+                out.append(
+                    (
+                        index,
+                        Anomaly(
+                            value,
+                            1.0,
+                            f"[SimpleThresholdStrategy]: Value {value} is not in bounds "
+                            f"[{self.lower_bound}, {self.upper_bound}]",
+                        ),
+                    )
+                )
+        return out
+
+
+@dataclass(frozen=True)
+class _BaseChangeStrategy(AnomalyDetectionStrategy):
+    """Nth-order discrete change detection
+    (reference `anomalydetection/BaseChangeStrategy.scala:30-95`)."""
+
+    max_rate_decrease: Optional[float] = None
+    max_rate_increase: Optional[float] = None
+    order: int = 1
+
+    def __post_init__(self):
+        if self.max_rate_decrease is None and self.max_rate_increase is None:
+            raise ValueError(
+                "At least one of the two limits (max_rate_decrease or max_rate_increase) "
+                "has to be specified."
+            )
+        lo = self.max_rate_decrease if self.max_rate_decrease is not None else _NEG_INF
+        hi = self.max_rate_increase if self.max_rate_increase is not None else _POS_INF
+        if lo > hi:
+            raise ValueError(
+                "The maximal rate of increase has to be bigger than the maximal rate of decrease."
+            )
+        if self.order < 0:
+            raise ValueError("Order of derivative cannot be negative.")
+
+    def diff(self, series: np.ndarray, order: int) -> np.ndarray:
+        if order == 0 or len(series) == 0:
+            return series
+        return self.diff(series[1:] - series[:-1], order - 1)
+
+    def detect(self, data_series, search_interval):
+        start, end = search_interval
+        if start > end:
+            raise ValueError("The start of the interval cannot be larger than the end.")
+        start_point = max(start - self.order, 0)
+        window = np.asarray(data_series[start_point:end], dtype=np.float64)
+        data = self.diff(window, self.order)
+        lo = self.max_rate_decrease if self.max_rate_decrease is not None else _NEG_INF
+        hi = self.max_rate_increase if self.max_rate_increase is not None else _POS_INF
+        out = []
+        for i, change in enumerate(data):
+            if change < lo or change > hi:
+                index = i + start_point + self.order
+                out.append(
+                    (
+                        index,
+                        Anomaly(
+                            data_series[index],
+                            1.0,
+                            f"[AbsoluteChangeStrategy]: Change of {change} is not in bounds "
+                            f"[{lo}, {hi}]. Order={self.order}",
+                        ),
+                    )
+                )
+        return out
+
+
+@dataclass(frozen=True)
+class AbsoluteChangeStrategy(_BaseChangeStrategy):
+    """(reference `anomalydetection/AbsoluteChangeStrategy.scala`)."""
+
+
+@dataclass(frozen=True)
+class RateOfChangeStrategy(_BaseChangeStrategy):
+    """Deprecated alias of AbsoluteChangeStrategy
+    (reference `anomalydetection/RateOfChangeStrategy.scala`)."""
+
+
+@dataclass(frozen=True)
+class RelativeRateOfChangeStrategy(_BaseChangeStrategy):
+    """Ratio (current / order-steps-back) change detection
+    (reference `anomalydetection/RelativeRateOfChangeStrategy.scala`)."""
+
+    def diff(self, series: np.ndarray, order: int) -> np.ndarray:
+        if order <= 0:
+            raise ValueError("Order of diff cannot be zero or negative")
+        if len(series) == 0:
+            return series
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return series[order:] / series[:-order]
+
+
+@dataclass(frozen=True)
+class OnlineNormalStrategy(AnomalyDetectionStrategy):
+    """Incremental mean/variance bounds with optional anomaly exclusion
+    (reference `anomalydetection/OnlineNormalStrategy.scala:39-45`)."""
+
+    lower_deviation_factor: Optional[float] = 3.0
+    upper_deviation_factor: Optional[float] = 3.0
+    ignore_start_percentage: float = 0.1
+    ignore_anomalies: bool = True
+
+    def __post_init__(self):
+        if self.lower_deviation_factor is None and self.upper_deviation_factor is None:
+            raise ValueError("At least one factor has to be specified.")
+        if (self.lower_deviation_factor or 1.0) < 0 or (self.upper_deviation_factor or 1.0) < 0:
+            raise ValueError("Factors cannot be smaller than zero.")
+        if not 0.0 <= self.ignore_start_percentage <= 1.0:
+            raise ValueError("Percentage of start values to ignore must be in interval [0, 1].")
+
+    def compute_stats_and_anomalies(self, data_series, search_interval=(0, 2**63 - 1)):
+        results = []
+        current_mean = 0.0
+        current_variance = 0.0
+        sn = 0.0
+        num_skip = len(data_series) * self.ignore_start_percentage
+        search_start, search_end = search_interval
+        upper_factor = (
+            self.upper_deviation_factor if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor if self.lower_deviation_factor is not None else _POS_INF
+        )
+        for index, value in enumerate(data_series):
+            last_mean, last_variance, last_sn = current_mean, current_variance, sn
+            if index == 0:
+                current_mean = value
+            else:
+                current_mean = last_mean + (value - last_mean) / (index + 1)
+            sn += (value - last_mean) * (value - current_mean)
+            current_variance = sn / (index + 1)
+            std_dev = math.sqrt(current_variance)
+            upper = current_mean + upper_factor * std_dev
+            lower = current_mean - lower_factor * std_dev
+            if (
+                index < num_skip
+                or index < search_start
+                or index >= search_end
+                or lower <= value <= upper
+            ):
+                results.append((current_mean, std_dev, False))
+            else:
+                if self.ignore_anomalies:
+                    current_mean, current_variance, sn = last_mean, last_variance, last_sn
+                results.append((current_mean, std_dev, True))
+        return results
+
+    def detect(self, data_series, search_interval):
+        start, end = search_interval
+        if start > end:
+            raise ValueError("The start of the interval can't be larger than the end.")
+        stats = self.compute_stats_and_anomalies(data_series, search_interval)
+        upper_factor = (
+            self.upper_deviation_factor if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor if self.lower_deviation_factor is not None else _POS_INF
+        )
+        out = []
+        for index in range(start, min(end, len(data_series))):
+            mean, std_dev, is_anomaly = stats[index]
+            if not is_anomaly:
+                continue
+            lower = mean - lower_factor * std_dev
+            upper = mean + upper_factor * std_dev
+            out.append(
+                (
+                    index,
+                    Anomaly(
+                        data_series[index],
+                        1.0,
+                        f"[OnlineNormalStrategy]: Value {data_series[index]} is not in "
+                        f"bounds [{lower}, {upper}].",
+                    ),
+                )
+            )
+        return out
+
+
+@dataclass(frozen=True)
+class BatchNormalStrategy(AnomalyDetectionStrategy):
+    """Mean/stdDev bounds estimated from values outside the search interval
+    (reference `anomalydetection/BatchNormalStrategy.scala:33-36`)."""
+
+    lower_deviation_factor: Optional[float] = 3.0
+    upper_deviation_factor: Optional[float] = 3.0
+    include_interval: bool = False
+
+    def __post_init__(self):
+        if self.lower_deviation_factor is None and self.upper_deviation_factor is None:
+            raise ValueError("At least one factor has to be specified.")
+        if (self.lower_deviation_factor or 1.0) < 0 or (self.upper_deviation_factor or 1.0) < 0:
+            raise ValueError("Factors cannot be smaller than zero.")
+
+    def detect(self, data_series, search_interval):
+        start, end = search_interval
+        if start > end:
+            raise ValueError("The start of the interval can't be larger than the end.")
+        if len(data_series) == 0:
+            raise ValueError("Data series is empty. Can't calculate mean/ stdDev.")
+        series = np.asarray(data_series, dtype=np.float64)
+        end_capped = min(end, len(series))
+        if self.include_interval:
+            basis = series
+        else:
+            basis = np.concatenate([series[:start], series[end_capped:]])
+            if len(basis) == 0:
+                raise ValueError(
+                    "Excluding values in searchInterval from calculation but not enough values "
+                    "remain to calculate mean and stdDev."
+                )
+        mean = float(np.mean(basis))
+        # sample stddev like breeze meanAndVariance (ddof=1)
+        std_dev = float(np.std(basis, ddof=1)) if len(basis) > 1 else 0.0
+        upper_factor = (
+            self.upper_deviation_factor if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor if self.lower_deviation_factor is not None else _POS_INF
+        )
+        upper = mean + upper_factor * std_dev
+        lower = mean - lower_factor * std_dev
+        out = []
+        for index in range(start, end_capped):
+            value = series[index]
+            if value > upper or value < lower:
+                out.append(
+                    (
+                        index,
+                        Anomaly(
+                            float(value),
+                            1.0,
+                            f"[BatchNormalStrategy]: Value {value} is not in "
+                            f"bounds [{lower}, {upper}].",
+                        ),
+                    )
+                )
+        return out

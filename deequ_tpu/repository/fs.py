@@ -1,0 +1,70 @@
+"""File-backed metrics repository: the whole history lives in ONE json file;
+save = read-all, replace-key, rewrite — simple and atomic enough for metric
+histories, exactly the reference's strategy
+(reference `repository/fs/FileSystemMetricsRepository.scala:41-57`)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+from ..runners.context import AnalyzerContext
+from . import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+from .serde import deserialize_results, serialize_results
+
+
+class FileSystemMetricsRepository(MetricsRepository):
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, result_key: ResultKey, analyzer_context: AnalyzerContext) -> None:
+        successful = AnalyzerContext(
+            {a: m for a, m in analyzer_context.metric_map.items() if m.value.is_success}
+        )
+        existing = [r for r in self._read_all() if r.result_key != result_key]
+        existing.append(AnalysisResult(result_key, successful))
+        payload = serialize_results(existing)
+        # write-rename so a crash mid-write never corrupts the history
+        directory = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def load_by_key(self, result_key: ResultKey) -> Optional[AnalyzerContext]:
+        for result in self._read_all():
+            if result.result_key == result_key:
+                return result.analyzer_context
+        return None
+
+    def load(self) -> "FileSystemMetricsRepositoryMultipleResultsLoader":
+        return FileSystemMetricsRepositoryMultipleResultsLoader(self)
+
+    def _read_all(self) -> List[AnalysisResult]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            payload = f.read()
+        if not payload.strip():
+            return []
+        return deserialize_results(payload)
+
+
+class FileSystemMetricsRepositoryMultipleResultsLoader(MetricsRepositoryMultipleResultsLoader):
+    def __init__(self, repository: FileSystemMetricsRepository):
+        super().__init__()
+        self._repository = repository
+
+    def _all_results(self) -> List[AnalysisResult]:
+        return self._repository._read_all()

@@ -1,0 +1,297 @@
+"""VerificationSuite: the main orchestration façade.
+
+``VerificationSuite.on_data(data).add_check(check).run()`` collects the
+analyzers every check needs, delegates metric computation to the
+AnalysisRunner (one fused pass), evaluates checks against the resulting
+AnalyzerContext and reports an overall status
+(reference `VerificationSuite.scala:42-315`, `VerificationRunBuilder.scala:
+28-341`, `VerificationResult.scala:33-119`).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from .analyzers import Analyzer
+from .analyzers.state_provider import StateLoader, StatePersister
+from .checks import Check, CheckLevel, CheckResult, CheckStatus
+from .data import Dataset, Schema
+from .metrics import Metric
+from .runners.analysis_runner import AnalysisRunner
+from .runners.context import AnalyzerContext
+
+
+class VerificationResult:
+    """(reference `VerificationResult.scala:33-119`)."""
+
+    def __init__(
+        self,
+        status: CheckStatus,
+        check_results: Dict[Check, CheckResult],
+        metrics: Dict[Analyzer, Metric],
+    ):
+        self.status = status
+        self.check_results = check_results
+        self.metrics = metrics
+
+    def success_metrics_as_data_frame(self, for_analyzers: Sequence[Analyzer] = ()):
+        return AnalyzerContext(self.metrics).success_metrics_as_dataframe(for_analyzers)
+
+    def success_metrics_as_json(self, for_analyzers: Sequence[Analyzer] = ()) -> str:
+        return AnalyzerContext(self.metrics).success_metrics_as_json(for_analyzers)
+
+    def check_results_as_data_frame(self):
+        import pandas as pd
+
+        rows = []
+        for check, result in self.check_results.items():
+            for cr in result.constraint_results:
+                rows.append(
+                    {
+                        "check": check.description,
+                        "check_level": check.level.value,
+                        "check_status": result.status.value,
+                        "constraint": str(cr.constraint),
+                        "constraint_status": cr.status.value,
+                        "constraint_message": cr.message or "",
+                    }
+                )
+        return pd.DataFrame(
+            rows,
+            columns=[
+                "check",
+                "check_level",
+                "check_status",
+                "constraint",
+                "constraint_status",
+                "constraint_message",
+            ],
+        )
+
+    def check_results_as_json(self) -> str:
+        df = self.check_results_as_data_frame()
+        return json.dumps(df.to_dict(orient="records"))
+
+
+class VerificationSuite:
+    """(reference `VerificationSuite.scala:42-315`)."""
+
+    @staticmethod
+    def on_data(data: Dataset) -> "VerificationRunBuilder":
+        return VerificationRunBuilder(data)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def do_verification_run(
+        data: Dataset,
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+        *,
+        aggregate_with: Optional[StateLoader] = None,
+        save_states_with: Optional[StatePersister] = None,
+        metrics_repository: Optional[Any] = None,
+        reuse_existing_results_for_key: Optional[Any] = None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key: Optional[Any] = None,
+        batch_size: Optional[int] = None,
+        monitor: Optional[Any] = None,
+        sharding: Optional[Any] = None,
+    ) -> VerificationResult:
+        analyzers = list(required_analyzers)
+        for check in checks:
+            for a in check.required_analyzers():
+                analyzers.append(a)
+
+        analysis_results = AnalysisRunner.do_analysis_run(
+            data,
+            analyzers,
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            reuse_existing_results_for_key=reuse_existing_results_for_key,
+            fail_if_results_missing=fail_if_results_missing,
+            save_or_append_results_with_key=save_or_append_results_with_key,
+            batch_size=batch_size,
+            monitor=monitor,
+            sharding=sharding,
+        )
+        return VerificationSuite.evaluate(checks, analysis_results)
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema: Schema,
+        checks: Sequence[Check],
+        state_loaders: Sequence[StateLoader],
+        *,
+        required_analyzers: Sequence[Analyzer] = (),
+        save_states_with: Optional[StatePersister] = None,
+        metrics_repository: Optional[Any] = None,
+        save_or_append_results_with_key: Optional[Any] = None,
+    ) -> VerificationResult:
+        """Verification from merged persisted states, no data pass
+        (reference `VerificationSuite.scala:208-229`)."""
+        analyzers = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+        context = AnalysisRunner.run_on_aggregated_states(
+            schema,
+            analyzers,
+            state_loaders,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            save_or_append_results_with_key=save_or_append_results_with_key,
+        )
+        return VerificationSuite.evaluate(checks, context)
+
+    @staticmethod
+    def evaluate(checks: Sequence[Check], context: AnalyzerContext) -> VerificationResult:
+        """(reference `VerificationSuite.scala:263-281`)."""
+        check_results = {check: check.evaluate(context) for check in checks}
+        if not check_results:
+            status = CheckStatus.SUCCESS
+        else:
+            status = max(
+                (r.status for r in check_results.values()), key=lambda s: s.severity
+            )
+        return VerificationResult(status, check_results, dict(context.metric_map))
+
+
+@dataclass(frozen=True)
+class AnomalyCheckConfig:
+    """(reference `VerificationRunBuilder.scala:336`)."""
+
+    level: CheckLevel
+    description: str
+    with_tag_values: Dict[str, str] = field(default_factory=dict)
+    after_date: Optional[int] = None
+    before_date: Optional[int] = None
+
+
+class VerificationRunBuilder:
+    """Fluent run configuration (reference `VerificationRunBuilder.scala:
+    28-163`)."""
+
+    def __init__(self, data: Dataset):
+        self.data = data
+        self.checks: List[Check] = []
+        self.required_analyzers: List[Analyzer] = []
+        self._aggregate_with: Optional[StateLoader] = None
+        self._save_states_with: Optional[StatePersister] = None
+        self._metrics_repository = None
+        self._reuse_key = None
+        self._fail_if_results_missing = False
+        self._save_key = None
+        self._batch_size: Optional[int] = None
+        self._monitor = None
+        self._sharding = None
+        self._check_results_path: Optional[str] = None
+        self._success_metrics_path: Optional[str] = None
+
+    def add_check(self, check: Check) -> "VerificationRunBuilder":
+        self.checks.append(check)
+        return self
+
+    def add_checks(self, checks: Sequence[Check]) -> "VerificationRunBuilder":
+        self.checks.extend(checks)
+        return self
+
+    def add_required_analyzer(self, analyzer: Analyzer) -> "VerificationRunBuilder":
+        self.required_analyzers.append(analyzer)
+        return self
+
+    def add_required_analyzers(self, analyzers: Sequence[Analyzer]) -> "VerificationRunBuilder":
+        self.required_analyzers.extend(analyzers)
+        return self
+
+    def aggregate_with(self, state_loader: StateLoader) -> "VerificationRunBuilder":
+        self._aggregate_with = state_loader
+        return self
+
+    def save_states_with(self, state_persister: StatePersister) -> "VerificationRunBuilder":
+        self._save_states_with = state_persister
+        return self
+
+    def with_batch_size(self, batch_size: int) -> "VerificationRunBuilder":
+        self._batch_size = batch_size
+        return self
+
+    def with_monitor(self, monitor) -> "VerificationRunBuilder":
+        self._monitor = monitor
+        return self
+
+    def with_sharding(self, sharding) -> "VerificationRunBuilder":
+        self._sharding = sharding
+        return self
+
+    def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        self._check_results_path = path
+        return self
+
+    def save_success_metrics_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        self._success_metrics_path = path
+        return self
+
+    def use_repository(self, repository) -> "VerificationRunBuilderWithRepository":
+        return VerificationRunBuilderWithRepository(self, repository)
+
+    def run(self) -> VerificationResult:
+        result = VerificationSuite.do_verification_run(
+            self.data,
+            self.checks,
+            self.required_analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+            batch_size=self._batch_size,
+            monitor=self._monitor,
+            sharding=self._sharding,
+        )
+        if self._check_results_path is not None:
+            with open(self._check_results_path, "w") as f:
+                f.write(result.check_results_as_json())
+        if self._success_metrics_path is not None:
+            with open(self._success_metrics_path, "w") as f:
+                f.write(result.success_metrics_as_json())
+        return result
+
+
+class VerificationRunBuilderWithRepository(VerificationRunBuilder):
+    """(reference `VerificationRunBuilder.scala:196-341`)."""
+
+    def __init__(self, parent: VerificationRunBuilder, repository):
+        self.__dict__.update(parent.__dict__)
+        self._metrics_repository = repository
+
+    def reuse_existing_results_for_key(
+        self, key, fail_if_results_missing: bool = False
+    ) -> "VerificationRunBuilderWithRepository":
+        self._reuse_key = key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, key) -> "VerificationRunBuilderWithRepository":
+        self._save_key = key
+        return self
+
+    def add_anomaly_check(
+        self, anomaly_detection_strategy, analyzer: Analyzer, anomaly_check_config=None
+    ) -> "VerificationRunBuilderWithRepository":
+        """(reference `VerificationRunBuilder.scala:227-244`)."""
+        description = f"Anomaly check for {analyzer}"
+        config = anomaly_check_config or AnomalyCheckConfig(CheckLevel.WARNING, description)
+        check = Check(config.level, config.description).is_newest_point_non_anomalous(
+            self._metrics_repository,
+            anomaly_detection_strategy,
+            analyzer,
+            config.with_tag_values,
+            config.after_date,
+            config.before_date,
+        )
+        self.checks.append(check)
+        return self

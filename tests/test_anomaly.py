@@ -1,0 +1,214 @@
+"""Anomaly detection tests — the analog of the reference
+`anomalydetection/*Test.scala` plus the repository+anomaly-check integration
+(`MetricsRepositoryAnomalyDetectionIntegrationTest.scala`)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.anomalydetection import (
+    AbsoluteChangeStrategy,
+    AnomalyDetector,
+    BatchNormalStrategy,
+    DataPoint,
+    HoltWinters,
+    MetricInterval,
+    OnlineNormalStrategy,
+    RelativeRateOfChangeStrategy,
+    SeriesSeasonality,
+    SimpleThresholdStrategy,
+)
+
+
+class TestSimpleThreshold:
+    def test_bounds(self):
+        s = SimpleThresholdStrategy(upper_bound=1.0, lower_bound=-1.0)
+        data = [-2.0, -0.5, 0.0, 0.5, 2.0]
+        found = s.detect(data, (0, len(data)))
+        assert [i for i, _ in found] == [0, 4]
+
+    def test_interval(self):
+        s = SimpleThresholdStrategy(upper_bound=1.0)
+        data = [5.0, 0.0, 5.0]
+        assert [i for i, _ in s.detect(data, (1, 2))] == []
+        assert [i for i, _ in s.detect(data, (2, 3))] == [2]
+
+    def test_invalid_bounds(self):
+        with pytest.raises(ValueError):
+            SimpleThresholdStrategy(upper_bound=-1.0, lower_bound=1.0)
+
+
+class TestChangeStrategies:
+    def test_absolute_change(self):
+        s = AbsoluteChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0)
+        data = [1.0, 2.0, 3.0, 10.0, 11.0, 5.0]
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert found == [3, 5]  # +7 jump and -6 drop
+
+    def test_second_order(self):
+        s = AbsoluteChangeStrategy(max_rate_increase=5.0, order=2)
+        # second derivative: jump in slope
+        data = [0.0, 1.0, 2.0, 3.0, 20.0, 37.0]
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert found == [4]
+
+    def test_relative_change(self):
+        s = RelativeRateOfChangeStrategy(max_rate_increase=2.0)
+        data = [1.0, 1.5, 6.0, 6.5]
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert found == [2]  # 6/1.5 = 4 > 2
+
+    def test_requires_a_bound(self):
+        with pytest.raises(ValueError):
+            AbsoluteChangeStrategy()
+
+
+class TestNormalStrategies:
+    def test_online_normal(self):
+        rng = np.random.default_rng(0)
+        data = list(rng.normal(10, 1, 100))
+        data[70] = 50.0
+        s = OnlineNormalStrategy()
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert found == [70]
+
+    def test_online_normal_excludes_anomalies_from_stats(self):
+        rng = np.random.default_rng(1)
+        data = list(rng.normal(0, 1, 60))
+        data[30] = 100.0
+        data[31] = 100.0
+        s = OnlineNormalStrategy(ignore_anomalies=True)
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert 30 in found and 31 in found
+
+    def test_batch_normal_excludes_interval(self):
+        rng = np.random.default_rng(2)
+        data = list(rng.normal(5, 1, 50)) + [5.0, 30.0]
+        s = BatchNormalStrategy()
+        found = [i for i, _ in s.detect(data, (50, 52))]
+        assert found == [51]
+
+    def test_batch_normal_empty_basis_raises(self):
+        s = BatchNormalStrategy()
+        with pytest.raises(ValueError):
+            s.detect([1.0, 2.0], (0, 2))
+
+
+class TestHoltWinters:
+    def test_detects_break_in_weekly_pattern(self):
+        # 5 weeks of a clean weekly pattern, then a broken day
+        pattern = [10.0, 12.0, 14.0, 13.0, 11.0, 5.0, 4.0]
+        series = pattern * 5
+        series[-2] = 50.0  # corrupt one point in the last (test) week
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        found = [i for i, _ in hw.detect(series, (28, 35))]
+        assert found == [33]
+
+    def test_needs_two_cycles(self):
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        with pytest.raises(ValueError):
+            hw.detect([1.0] * 20, (10, 20))
+
+
+class TestAnomalyDetector:
+    def test_new_point_protocol(self):
+        history = [DataPoint(t, 10.0) for t in range(10)]
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=15.0))
+        ok = detector.is_new_point_anomalous(history, DataPoint(11, 12.0))
+        assert ok.anomalies == ()
+        bad = detector.is_new_point_anomalous(history, DataPoint(12, 20.0))
+        assert len(bad.anomalies) == 1
+        assert bad.anomalies[0][0] == 12  # keyed by timestamp
+
+    def test_new_point_must_be_newer(self):
+        history = [DataPoint(t, 10.0) for t in range(10)]
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=15.0))
+        with pytest.raises(ValueError):
+            detector.is_new_point_anomalous(history, DataPoint(5, 12.0))
+
+    def test_missing_values_dropped(self):
+        history = [DataPoint(0, 1.0), DataPoint(1, None), DataPoint(2, 1.0)]
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=15.0))
+        result = detector.is_new_point_anomalous(history, DataPoint(3, 1.0))
+        assert result.anomalies == ()
+
+
+class TestAnomalyCheckIntegration:
+    def test_add_anomaly_check(self, df_full):
+        """Size history 4,4,4 -> new point 4 fine; threshold catches drift
+        (the reference `VerificationRunBuilder.addAnomalyCheck` path)."""
+        from deequ_tpu import CheckStatus, VerificationSuite
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+        from deequ_tpu.runners import AnalysisRunner
+
+        repo = InMemoryMetricsRepository()
+        for t in (1, 2, 3):
+            ctx = AnalysisRunner.do_analysis_run(df_full, [Size()])
+            repo.save(ResultKey(t), ctx)
+
+        result = (
+            VerificationSuite.on_data(df_full)
+            .use_repository(repo)
+            .add_anomaly_check(
+                AbsoluteChangeStrategy(max_rate_decrease=-1.0, max_rate_increase=1.0),
+                Size(),
+            )
+            .run()
+        )
+        assert result.status == CheckStatus.SUCCESS
+
+        # drastically smaller dataset -> warning
+        import pyarrow as pa
+
+        from deequ_tpu.data import Dataset
+
+        small = Dataset.from_arrow(pa.table({"item": pa.array(["1"])}))
+        result2 = (
+            VerificationSuite.on_data(small)
+            .use_repository(repo)
+            .add_anomaly_check(
+                AbsoluteChangeStrategy(max_rate_decrease=-1.0, max_rate_increase=1.0),
+                Size(),
+            )
+            .run()
+        )
+        assert result2.status == CheckStatus.WARNING
+
+    def test_history_from_repository_with_tags(self, df_full):
+        from deequ_tpu import CheckStatus, VerificationSuite
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+        from deequ_tpu.runners import AnalysisRunner
+        from deequ_tpu.verification import AnomalyCheckConfig
+        from deequ_tpu.checks import CheckLevel
+
+        repo = InMemoryMetricsRepository()
+        ctx = AnalysisRunner.do_analysis_run(df_full, [Size()])
+        repo.save(ResultKey(1, {"env": "prod"}), ctx)
+        repo.save(ResultKey(2, {"env": "test"}), ctx)
+
+        config = AnomalyCheckConfig(
+            CheckLevel.ERROR, "tagged anomaly check", with_tag_values={"env": "prod"}
+        )
+        result = (
+            VerificationSuite.on_data(df_full)
+            .use_repository(repo)
+            .add_anomaly_check(
+                SimpleThresholdStrategy(upper_bound=10.0), Size(), config
+            )
+            .run()
+        )
+        assert result.status == CheckStatus.SUCCESS
+
+
+class TestFiniteSentinels:
+    def test_one_sided_online_normal_constant_series(self):
+        # a perfectly constant series must never be anomalous (stdDev 0:
+        # MAX*0 stays 0, never NaN)
+        s = OnlineNormalStrategy(lower_deviation_factor=None)
+        assert s.detect([1.0] * 10, (1, 10)) == []
+
+    def test_one_sided_batch_normal_catches_outlier(self):
+        s = BatchNormalStrategy(upper_deviation_factor=None)
+        found = s.detect([1.0, 1.0, 1.0, 1.0, -100.0], (4, 5))
+        assert [i for i, _ in found] == [4]

@@ -1,0 +1,264 @@
+"""Check/Constraint DSL + VerificationSuite tests — the analog of the
+reference `checks/CheckTest.scala`, `constraints/ConstraintsTest.scala` and
+`VerificationSuiteTest.scala` (incl. the BasicExample end-to-end)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import (
+    Check,
+    CheckLevel,
+    CheckStatus,
+    Dataset,
+    VerificationSuite,
+)
+from deequ_tpu.analyzers import Completeness, Size
+from deequ_tpu.constraints import (
+    AnalysisBasedConstraint,
+    ConstrainableDataTypes,
+    ConstraintStatus,
+    MISSING_ANALYSIS_MESSAGE,
+    completeness_constraint,
+)
+from deequ_tpu.metrics import DoubleMetric, Entity, Success
+
+
+class TestConstraintEvaluation:
+    def test_missing_analysis(self):
+        c = completeness_constraint("att1", lambda v: v == 1.0)
+        result = c.evaluate({})
+        assert result.status == ConstraintStatus.FAILURE
+        assert MISSING_ANALYSIS_MESSAGE in result.message
+
+    def test_success_and_failure(self):
+        analyzer = Completeness("att1")
+        metric = DoubleMetric(Entity.COLUMN, "Completeness", "att1", Success(0.5))
+        ok = AnalysisBasedConstraint(analyzer, lambda v: v == 0.5)
+        bad = AnalysisBasedConstraint(analyzer, lambda v: v > 0.9)
+        assert ok.evaluate({analyzer: metric}).status == ConstraintStatus.SUCCESS
+        res = bad.evaluate({analyzer: metric})
+        assert res.status == ConstraintStatus.FAILURE
+        assert "Value: 0.5 does not meet the constraint requirement!" in res.message
+
+    def test_picker_and_assertion_errors_are_captured(self):
+        analyzer = Completeness("att1")
+        metric = DoubleMetric(Entity.COLUMN, "Completeness", "att1", Success(0.5))
+        bad_picker = AnalysisBasedConstraint(
+            analyzer, lambda v: True, value_picker=lambda v: 1 / 0
+        )
+        assert bad_picker.evaluate({analyzer: metric}).status == ConstraintStatus.FAILURE
+        bad_assert = AnalysisBasedConstraint(analyzer, lambda v: 1 / 0 > 0)
+        assert bad_assert.evaluate({analyzer: metric}).status == ConstraintStatus.FAILURE
+
+    def test_hint_in_message(self):
+        analyzer = Completeness("att1")
+        metric = DoubleMetric(Entity.COLUMN, "Completeness", "att1", Success(0.5))
+        c = AnalysisBasedConstraint(analyzer, lambda v: v > 0.9, hint="expect high completeness")
+        assert "expect high completeness" in c.evaluate({analyzer: metric}).message
+
+
+class TestCheckDSL:
+    def test_basic_example_end_to_end(self):
+        """The reference `examples/BasicExample.scala` scenario."""
+        data = Dataset.from_dict(
+            {
+                "id": [1, 2, 3, 4, 5],
+                "productName": ["Thingy A", "Thingy B", None, "Thingy D", "Thingy E"],
+                "description": [
+                    "awesome thing.",
+                    "available at http://thingb.com",
+                    None,
+                    "checkout https://thingd.ca",
+                    "thingy model E",
+                ],
+                "rating": ["high", "high", None, "low", "high"],
+                "numViews": [0, 0, 56, 0, 86],
+            }
+        )
+        check = (
+            Check(CheckLevel.ERROR, "unit testing my data")
+            .has_size(lambda v: v == 5)
+            .is_complete("id")
+            .is_unique("id")
+            .is_complete("productName")
+            .is_contained_in("rating", allowed_values=["high", "low"])
+            .is_non_negative("numViews")
+        )
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        statuses = {
+            str(cr.constraint): cr.status
+            for r in result.check_results.values()
+            for cr in r.constraint_results
+        }
+        # productName has a null -> isComplete fails; everything else passes
+        failures = [k for k, v in statuses.items() if v == ConstraintStatus.FAILURE]
+        assert len(failures) == 1
+        assert "productName" in failures[0]
+        assert result.status == CheckStatus.ERROR
+
+    def test_warning_level(self, df_missing):
+        check = Check(CheckLevel.WARNING, "warn").is_complete("att1")
+        result = VerificationSuite.on_data(df_missing).add_check(check).run()
+        assert result.status == CheckStatus.WARNING
+
+    def test_success_status(self, df_full):
+        check = (
+            Check(CheckLevel.ERROR, "ok")
+            .has_size(lambda v: v == 4)
+            .is_complete("att1")
+            .has_completeness("att1", lambda v: v == 1.0)
+        )
+        result = VerificationSuite.on_data(df_full).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_where_filter(self, df_numeric):
+        check = Check(CheckLevel.ERROR, "filtered").has_max(
+            "att1", lambda v: v == 3.0
+        ).where("att1 <= 3")
+        result = VerificationSuite.on_data(df_numeric).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_scan_sharing_across_checks(self, df_full):
+        """Analyzers shared between checks compute once, one pass total
+        (the SparkMonitor jobs-count analog)."""
+        from deequ_tpu.runners.engine import RunMonitor
+
+        mon = RunMonitor()
+        c1 = Check(CheckLevel.ERROR, "a").has_size(lambda v: v == 4).is_complete("att1")
+        c2 = Check(CheckLevel.ERROR, "b").is_complete("att1").is_complete("att2")
+        result = (
+            VerificationSuite.on_data(df_full)
+            .add_check(c1)
+            .add_check(c2)
+            .with_monitor(mon)
+            .run()
+        )
+        assert mon.passes == 1
+        assert result.status == CheckStatus.SUCCESS
+        # one metric per distinct analyzer (Size, Completeness x2)
+        assert len(result.metrics) == 3
+
+    def test_uniqueness_checks(self, df_full):
+        check = (
+            Check(CheckLevel.ERROR, "unique")
+            .is_unique("item")
+            .is_primary_key("item", "att1")
+            .has_uniqueness(["att1"], lambda v: v < 0.5)
+            .has_distinctness(["att1"], lambda v: v == 0.5)
+        )
+        result = VerificationSuite.on_data(df_full).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_pattern_checks(self):
+        data = Dataset.from_dict(
+            {
+                "email": ["a@example.com", "b@test.org", "not-an-email"],
+                "url": ["https://x.io", "nope", "http://y.de/z"],
+            }
+        )
+        check = (
+            Check(CheckLevel.ERROR, "patterns")
+            .contains_email("email", lambda v: abs(v - 2 / 3) < 1e-9)
+            .contains_url("url", lambda v: abs(v - 2 / 3) < 1e-9)
+        )
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_data_type_check(self):
+        data = Dataset.from_dict({"mixed": ["1", "2.0", "three", "4"]})
+        check = Check(CheckLevel.ERROR, "dt").has_data_type(
+            "mixed", ConstrainableDataTypes.INTEGRAL, lambda v: v == 0.5
+        )
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS, [
+            cr.message
+            for r in result.check_results.values()
+            for cr in r.constraint_results
+        ]
+
+    def test_comparison_checks(self, df_numeric):
+        check = (
+            Check(CheckLevel.ERROR, "cmp")
+            .is_less_than_or_equal_to("att2", "att1", lambda v: v > 0.4)
+            .is_contained_in("att1", lower_bound=1, upper_bound=6)
+        )
+        result = VerificationSuite.on_data(df_numeric).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_quantile_check(self):
+        data = Dataset.from_dict({"x": np.arange(1, 101, dtype=np.float64)})
+        check = Check(CheckLevel.ERROR, "q").has_approx_quantile(
+            "x", 0.5, lambda v: 45 <= v <= 55
+        )
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_kll_check(self):
+        data = Dataset.from_dict({"x": np.arange(0, 100, dtype=np.float64)})
+        from deequ_tpu.analyzers import KLLParameters
+
+        check = Check(CheckLevel.ERROR, "kll").kll_sketch_satisfies(
+            "x",
+            lambda dist: dist.buckets[0].count == 50,
+            KLLParameters(1024, 0.64, 2),
+        )
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_histogram_checks(self, df_full):
+        check = (
+            Check(CheckLevel.ERROR, "hist")
+            .has_number_of_distinct_values("att1", lambda v: v == 2)
+            .has_histogram_values("att1", lambda d: d["a"].absolute == 3)
+        )
+        result = VerificationSuite.on_data(df_full).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_entropy_and_mi(self, df_full):
+        expected_entropy = -(0.75 * np.log(0.75) + 0.25 * np.log(0.25))
+        check = Check(CheckLevel.ERROR, "ent").has_entropy(
+            "att1", lambda v: abs(v - expected_entropy) < 1e-9
+        )
+        result = VerificationSuite.on_data(df_full).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_check_results_dataframe(self, df_full):
+        check = Check(CheckLevel.ERROR, "df").has_size(lambda v: v == 999)
+        result = VerificationSuite.on_data(df_full).add_check(check).run()
+        df = result.check_results_as_data_frame()
+        assert list(df["check_status"]) == ["Error"]
+        assert "does not meet the constraint requirement" in df["constraint_message"][0]
+        mdf = result.success_metrics_as_data_frame()
+        assert set(mdf.columns) == {"entity", "instance", "name", "value"}
+        assert len(mdf) == 1
+
+    def test_required_analyzers_dedupe(self):
+        c = Check(CheckLevel.ERROR, "x").is_complete("a").has_completeness("a", lambda v: v > 0)
+        assert c.required_analyzers() == {Completeness("a")}
+
+    def test_verification_on_aggregated_states(self, df_full):
+        from deequ_tpu.analyzers import InMemoryStateProvider
+        from deequ_tpu.runners import AnalysisRunner
+
+        s1, s2 = InMemoryStateProvider(), InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(df_full, [Size()], save_states_with=s1)
+        AnalysisRunner.do_analysis_run(df_full, [Size()], save_states_with=s2)
+        check = Check(CheckLevel.ERROR, "agg").has_size(lambda v: v == 8)
+        result = VerificationSuite.run_on_aggregated_states(
+            df_full.schema, [check], [s1, s2]
+        )
+        assert result.status == CheckStatus.SUCCESS
+
+
+class TestIsContainedInNumeric:
+    def test_numeric_allowed_values(self):
+        data = Dataset.from_dict({"x": [1, 2, 3, 1, 2]})
+        check = Check(CheckLevel.ERROR, "n").is_contained_in("x", allowed_values=[1, 2, 3])
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_numeric_detects_violation(self):
+        data = Dataset.from_dict({"x": [1, 2, 99]})
+        check = Check(CheckLevel.ERROR, "n").is_contained_in("x", allowed_values=[1, 2])
+        result = VerificationSuite.on_data(data).add_check(check).run()
+        assert result.status == CheckStatus.ERROR

@@ -1,0 +1,235 @@
+"""Metrics repository tests: serde round-trips for every metric/analyzer
+type, key semantics, tag/time/analyzer-filtered loads, scheduler reuse —
+the analog of the reference `repository/*Test.scala`."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.repository import (
+    FileSystemMetricsRepository,
+    InMemoryMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository.serde import (
+    deserialize_analyzer,
+    serialize_analyzer,
+)
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+ALL_ANALYZERS = [
+    Size(),
+    Size(where="x > 2"),
+    Completeness("item"),
+    Completeness("item", "x > 1"),
+    Compliance("rule", "x > 0"),
+    PatternMatch("item", r"\d+"),
+    Mean("x"),
+    Sum("x"),
+    Minimum("x"),
+    Maximum("x"),
+    MinLength("item"),
+    MaxLength("item"),
+    StandardDeviation("x"),
+    Correlation("x", "y"),
+    DataType("item"),
+    ApproxCountDistinct("item"),
+    ApproxQuantile("x", 0.5),
+    ApproxQuantiles("x", (0.25, 0.75)),
+    KLLSketch("x", KLLParameters(128, 0.64, 5)),
+    KLLSketch("x"),
+    Uniqueness(("item",)),
+    Distinctness(("item",)),
+    UniqueValueRatio(("item",)),
+    CountDistinct(("item",)),
+    Entropy("item"),
+    MutualInformation(("item", "other")),
+    Histogram("item"),
+]
+
+
+class TestAnalyzerSerde:
+    @pytest.mark.parametrize("analyzer", ALL_ANALYZERS, ids=lambda a: repr(a)[:50])
+    def test_roundtrip(self, analyzer):
+        assert deserialize_analyzer(serialize_analyzer(analyzer)) == analyzer
+
+
+@pytest.fixture
+def small_data():
+    return Dataset.from_dict(
+        {
+            "item": ["a", "b", "c", "a"],
+            "other": ["x", "x", "y", "y"],
+            "x": [1.0, 2.0, 3.0, 4.0],
+            "y": [2.0, 4.0, 6.0, 8.0],
+        }
+    )
+
+
+def full_context(small_data):
+    analyzers = [
+        Size(),
+        Mean("x"),
+        ApproxQuantiles("x", (0.5,)),
+        KLLSketch("x", KLLParameters(128, 0.64, 4)),
+        Histogram("item"),
+        DataType("item"),
+    ]
+    return AnalysisRunner.do_analysis_run(small_data, analyzers)
+
+
+class TestRepositories:
+    @pytest.mark.parametrize("repo_kind", ["memory", "fs"])
+    def test_save_load_roundtrip(self, small_data, tmp_path, repo_kind):
+        repo = (
+            InMemoryMetricsRepository()
+            if repo_kind == "memory"
+            else FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
+        )
+        context = full_context(small_data)
+        key = ResultKey(1000, {"tag": "a"})
+        repo.save(key, context)
+        loaded = repo.load_by_key(key)
+        assert loaded is not None
+        assert set(loaded.metric_map.keys()) == set(context.metric_map.keys())
+        for a, m in context.metric_map.items():
+            got = loaded.metric_map[a]
+            assert got.value.is_success
+            if a == Mean("x"):
+                assert got.value.get() == m.value.get() == 2.5
+        # KLL metric percentile re-derivation survives the round trip
+        kll = loaded.metric_map[KLLSketch("x", KLLParameters(128, 0.64, 4))]
+        pcts = kll.value.get().compute_percentiles()
+        assert pcts[-1] == 4.0
+
+    def test_save_replaces_key(self, small_data):
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(1)
+        ctx1 = AnalysisRunner.do_analysis_run(small_data, [Size()])
+        ctx2 = AnalysisRunner.do_analysis_run(small_data, [Mean("x")])
+        repo.save(key, ctx1)
+        repo.save(key, ctx2)
+        loaded = repo.load_by_key(key)
+        assert Size() not in loaded.metric_map
+        assert Mean("x") in loaded.metric_map
+
+    def test_loader_filters(self, small_data):
+        repo = InMemoryMetricsRepository()
+        ctx = AnalysisRunner.do_analysis_run(small_data, [Size(), Mean("x")])
+        repo.save(ResultKey(100, {"env": "prod"}), ctx)
+        repo.save(ResultKey(200, {"env": "test"}), ctx)
+        repo.save(ResultKey(300, {"env": "prod"}), ctx)
+
+        assert len(repo.load().get()) == 3
+        assert len(repo.load().with_tag_values({"env": "prod"}).get()) == 2
+        assert len(repo.load().after(150).get()) == 2
+        assert len(repo.load().before(150).get()) == 1
+        assert len(repo.load().after(150).before(250).get()) == 1
+        only_size = repo.load().for_analyzers([Size()]).get()
+        assert all(set(r.analyzer_context.metric_map) == {Size()} for r in only_size)
+
+    def test_loader_dataframe(self, small_data):
+        repo = InMemoryMetricsRepository()
+        ctx = AnalysisRunner.do_analysis_run(small_data, [Size()])
+        repo.save(ResultKey(100, {"env": "prod"}), ctx)
+        df = repo.load().get_success_metrics_as_data_frame(with_tags=["env"])
+        assert list(df["env"]) == ["prod"]
+        assert list(df["value"]) == [4.0]
+
+    def test_scheduler_reuse_skips_pass(self, small_data):
+        """Repository reuse eliminates the data pass entirely — the analog of
+        the reference job-count assertion (`AnalysisRunnerTests.scala:120-150`)."""
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(1)
+        mon1 = RunMonitor()
+        AnalysisRunner.do_analysis_run(
+            small_data,
+            [Size(), Mean("x")],
+            metrics_repository=repo,
+            save_or_append_results_with_key=key,
+            monitor=mon1,
+        )
+        assert mon1.passes == 1
+        mon2 = RunMonitor()
+        ctx = AnalysisRunner.do_analysis_run(
+            small_data,
+            [Size(), Mean("x")],
+            metrics_repository=repo,
+            reuse_existing_results_for_key=key,
+            monitor=mon2,
+        )
+        assert mon2.passes == 0  # everything served from the repository
+        assert ctx.metric(Size()).value.get() == 4.0
+
+    def test_fail_if_results_missing(self, small_data):
+        from deequ_tpu.runners.exceptions import MetricCalculationException
+
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(1)
+        AnalysisRunner.do_analysis_run(
+            small_data, [Size()], metrics_repository=repo,
+            save_or_append_results_with_key=key,
+        )
+        with pytest.raises(MetricCalculationException):
+            AnalysisRunner.do_analysis_run(
+                small_data,
+                [Size(), Mean("x")],
+                metrics_repository=repo,
+                reuse_existing_results_for_key=key,
+                fail_if_results_missing=True,
+            )
+
+    def test_append_semantics(self, small_data):
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(7)
+        AnalysisRunner.do_analysis_run(
+            small_data, [Size()], metrics_repository=repo,
+            save_or_append_results_with_key=key,
+        )
+        AnalysisRunner.do_analysis_run(
+            small_data, [Mean("x")], metrics_repository=repo,
+            save_or_append_results_with_key=key,
+        )
+        loaded = repo.load_by_key(key)
+        assert Size() in loaded.metric_map and Mean("x") in loaded.metric_map
+
+    def test_fs_repo_survives_reopen(self, small_data, tmp_path):
+        path = str(tmp_path / "history.json")
+        repo = FileSystemMetricsRepository(path)
+        ctx = AnalysisRunner.do_analysis_run(small_data, [Size()])
+        repo.save(ResultKey(1), ctx)
+        reopened = FileSystemMetricsRepository(path)
+        assert reopened.load_by_key(ResultKey(1)).metric_map[Size()].value.get() == 4.0
+
+
+def test_kll_where_roundtrip():
+    a = KLLSketch("x", KLLParameters(128, 0.64, 4), where="x > 0")
+    assert deserialize_analyzer(serialize_analyzer(a)) == a
