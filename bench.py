@@ -1,0 +1,145 @@
+"""Benchmark: fused single-pass analyzer scan throughput on the real device.
+
+Measures the BASELINE.json north-star proxy — analyzer-engine rows/sec/chip
+on a representative battery (completeness, moments, min/max, HLL distinct,
+KLL quantile sketch over multiple columns) — and compares against a
+single-core pandas/numpy oracle computing the same metrics on the same data
+(the stand-in for the reference's Spark-local per-core throughput; the
+reference publishes no numbers, BASELINE.md).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_data(rows: int):
+    import pyarrow as pa
+
+    rng = np.random.default_rng(42)
+    cols = {}
+    for i in range(4):
+        vals = rng.normal(100 * i, 10, rows)
+        nulls = rng.random(rows) < 0.05
+        cols[f"x{i}"] = pa.array(vals, mask=nulls)
+    cols["cat"] = pa.array(rng.integers(0, 100_000, rows))
+    return pa.table(cols)
+
+
+def analyzer_battery():
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        KLLParameters,
+        KLLSketch,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+        Sum,
+    )
+
+    analyzers = []
+    for i in range(4):
+        c = f"x{i}"
+        analyzers += [
+            Completeness(c), Mean(c), Sum(c), Minimum(c), Maximum(c),
+            StandardDeviation(c),
+        ]
+    analyzers.append(ApproxCountDistinct("cat"))
+    analyzers += [KLLSketch("x0", KLLParameters(2048, 0.64, 100)),
+                  KLLSketch("x1", KLLParameters(2048, 0.64, 100))]
+    return analyzers
+
+
+def run_tpu(table, batch_size: int) -> tuple[float, dict]:
+    import jax
+
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.engine import RunMonitor
+
+    data = Dataset.from_arrow(table)
+    analyzers = analyzer_battery()
+    log(f"devices: {jax.devices()}")
+
+    # warmup: compile the fused program on one batch
+    warm = Dataset.from_arrow(table.slice(0, batch_size))
+    AnalysisRunner.do_analysis_run(warm, analyzers, batch_size=batch_size)
+
+    mon = RunMonitor()
+    t0 = time.perf_counter()
+    ctx = AnalysisRunner.do_analysis_run(
+        data, analyzers, batch_size=batch_size, monitor=mon
+    )
+    elapsed = time.perf_counter() - t0
+    assert mon.passes == 1
+    values = {}
+    for a, m in ctx.metric_map.items():
+        if m.value.is_success and a.name in ("Completeness", "Mean", "Sum"):
+            values[f"{a.name}:{a.instance}"] = m.value.get()
+    return elapsed, values
+
+
+def run_pandas_baseline(table, rows: int) -> tuple[float, dict]:
+    """Same metrics, single-core pandas/numpy on the full data."""
+    df = table.to_pandas()
+    t0 = time.perf_counter()
+    values = {}
+    for i in range(4):
+        c = f"x{i}"
+        s = df[c]
+        values[f"Completeness:{c}"] = s.notna().mean()
+        values[f"Mean:{c}"] = s.mean()
+        values[f"Sum:{c}"] = s.sum()
+        s.min(); s.max(); s.std(ddof=0)
+    df["cat"].nunique()
+    np.nanquantile(df["x0"].to_numpy(), np.linspace(0.01, 1, 100))
+    np.nanquantile(df["x1"].to_numpy(), np.linspace(0.01, 1, 100))
+    elapsed = time.perf_counter() - t0
+    return elapsed, values
+
+
+def main() -> None:
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000_000
+    batch_size = 1 << 20
+    log(f"building {rows:,}-row table")
+    table = build_data(rows)
+
+    tpu_s, tpu_vals = run_tpu(table, batch_size)
+    log(f"tpu pass: {tpu_s:.2f}s ({rows / tpu_s / 1e6:.2f}M rows/s)")
+    base_s, base_vals = run_pandas_baseline(table, rows)
+    log(f"pandas baseline (extrapolated single-core): {base_s:.2f}s")
+
+    # metric parity guard: same answers as the oracle (±1e-6 relative)
+    for k, v in base_vals.items():
+        tv = tpu_vals[k]
+        if abs(tv - v) > 1e-6 * max(1.0, abs(v)):
+            log(f"PARITY MISMATCH {k}: tpu={tv} oracle={v}")
+            sys.exit(1)
+
+    rows_per_sec = rows / tpu_s
+    print(
+        json.dumps(
+            {
+                "metric": "analyzer_scan_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / (rows / base_s), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

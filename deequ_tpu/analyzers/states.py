@@ -250,7 +250,8 @@ class ApproxCountDistinctState:
 
 
 def to_host(state: Any) -> Any:
-    """Bring a device state pytree back as numpy (for persistence/finalize)."""
+    """Bring a device state pytree back as numpy (for persistence/finalize).
+    Uses device_get so all leaves copy in one batched round-trip."""
     import jax
 
-    return jax.tree_util.tree_map(np.asarray, state)
+    return jax.device_get(state)

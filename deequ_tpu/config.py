@@ -15,6 +15,19 @@ import jax
 if not os.environ.get("DEEQU_TPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
+# persistent XLA compilation cache: fused analyzer programs are large (tens
+# of seconds to compile) and identical across processes/runs
+if not os.environ.get("DEEQU_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = os.environ.get(
+        "DEEQU_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/deequ_tpu_xla")
+    )
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        pass
+
 import jax.numpy as jnp  # noqa: E402  (after x64 setup)
 
 #: dtype used for floating-point accumulator states (sums, moments, ...)

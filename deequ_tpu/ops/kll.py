@@ -39,6 +39,12 @@ import numpy as np
 
 from ..config import ACC_DTYPE, COUNT_DTYPE
 
+#: sketch items are f32: quantile VALUE precision (1e-7 relative) is orders
+#: of magnitude finer than the sketch's RANK error, and f32 sorts run on the
+#: TPU's native path instead of emulated f64. min/max/count stay ACC/COUNT
+#: dtype for exact parity.
+ITEM_DTYPE = jnp.float32
+
 #: defaults matching the reference (`analyzers/KLLSketch.scala:172-176`)
 DEFAULT_SKETCH_SIZE = 2048
 DEFAULT_SHRINKING_FACTOR = 0.64
@@ -75,7 +81,7 @@ class KLLSketchState:
 def kll_init(sketch_size: int = DEFAULT_SKETCH_SIZE, levels: int = MAX_LEVELS) -> KLLSketchState:
     k = int(sketch_size)
     return KLLSketchState(
-        items=jnp.full((levels, 4 * k), _INF, dtype=ACC_DTYPE),
+        items=jnp.full((levels, 4 * k), _INF, dtype=ITEM_DTYPE),
         sizes=jnp.zeros(levels, dtype=jnp.int32),
         parity=jnp.zeros(levels, dtype=jnp.int32),
         ticks=jnp.zeros((), dtype=jnp.int32),
@@ -151,7 +157,13 @@ def kll_update(state: KLLSketchState, values: jnp.ndarray, valid: jnp.ndarray) -
     g_min = jnp.minimum(state.g_min, jnp.min(jnp.where(ok, v, jnp.inf)))
     g_max = jnp.maximum(state.g_max, jnp.max(jnp.where(ok, v, -jnp.inf)))
 
-    sv = jnp.sort(jnp.where(ok, v, _INF))
+    # clamp to the finite ITEM_DTYPE range before the cast: a legitimate
+    # |value| > 3.4e38 must saturate, not become inf and collide with the
+    # padding sentinel (quantiles at such magnitudes saturate; min/max/count
+    # stay exact in ACC_DTYPE)
+    finfo_max = jnp.asarray(jnp.finfo(ITEM_DTYPE).max, dtype=v.dtype)
+    clamped = jnp.clip(v, -finfo_max, finfo_max)
+    sv = jnp.sort(jnp.where(ok, clamped, _INF).astype(ITEM_DTYPE))
 
     # pre-collapse the batch: stride 2^h subsampling of the sorted batch is
     # equivalent to h perfect pairwise compactions, landing ≤ k items of

@@ -1,0 +1,119 @@
+"""Multi-device execution: row-sharded scans over a jax Mesh.
+
+The reference's only parallelism is data parallelism over row partitions
+with algebraic state merge (Spark partial aggregation + shuffle;
+`rdd.treeReduce` for KLL — see SURVEY.md §2.9). TPU-native equivalents here:
+
+1. **GSPMD scan** (`sharded_update`): the fused per-batch update is jit'd
+   with the feature arrays sharded over the mesh's ``rows`` axis and the
+   state pytrees replicated; XLA inserts the partial-reduce + collective
+   combine automatically — the analog of Spark's partial-agg + shuffle, but
+   compiled, fused and riding ICI.
+2. **Explicit collective merge** (`collective_merge_states`): a shard_map
+   program that all-gathers per-device state pytrees over the mesh axis and
+   folds them with each analyzer's semigroup ``merge`` — the
+   `KLLRunner.treeReduce` analog (reference `analyzers/runners/
+   KLLRunner.scala:104-112`) for states whose merge is not a plain ``psum``
+   (HLL register max, KLL level concat + compaction).
+
+Cross-host: the same code runs under multi-host jax (`jax.distributed`);
+mesh axes spanning hosts make the collectives ride DCN. States serialize to
+numpy pytrees (see `analyzers/state_provider.py`) for the offline/
+partitioned merge path (`AnalysisRunner.run_on_aggregated_states`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "rows"
+
+
+def make_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the row axis (data parallelism over row shards)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (ROW_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROW_AXIS))
+
+
+def shard_features(
+    features: Dict[str, np.ndarray], mesh: Mesh, batch_rows: Optional[int] = None
+) -> Dict[str, jax.Array]:
+    """Place feature arrays row-sharded over the mesh. The batch axis is the
+    one whose extent equals ``batch_rows`` (the engine pads batches to a
+    multiple of the mesh size); e.g. the (2, B) HLL pairs shard on their
+    LAST dim. Without ``batch_rows`` it is inferred from the 1-D arrays
+    (the row mask is always present)."""
+    if batch_rows is None:
+        batch_rows = max(
+            (a.shape[0] for a in features.values() if a.ndim == 1), default=0
+        )
+    out = {}
+    for key, arr in features.items():
+        if arr.ndim >= 1 and arr.shape[0] == batch_rows:
+            spec = P(ROW_AXIS, *([None] * (arr.ndim - 1)))
+        elif arr.ndim >= 2 and arr.shape[-1] == batch_rows:
+            spec = P(*([None] * (arr.ndim - 1)), ROW_AXIS)
+        else:
+            spec = P()
+        out[key] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def sharded_update(analyzers: Sequence[Any], mesh: Mesh):
+    """jit the fused update with states replicated and features row-sharded;
+    XLA turns every reduction into partial-per-device + collective."""
+
+    def fused(states: Tuple, features: Dict[str, jax.Array]) -> Tuple:
+        return tuple(a.update(s, features) for a, s in zip(analyzers, states))
+
+    return jax.jit(
+        fused,
+        in_shardings=(replicated(mesh), None),  # features keep their placement
+        out_shardings=replicated(mesh),
+        donate_argnums=0,
+    )
+
+
+def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_states):
+    """Fold per-shard state pytrees with each analyzer's semigroup ``merge``
+    in ONE jit'd device program — the treeReduce analog (reference
+    `analyzers/runners/KLLRunner.scala:104-112`). ``per_shard_states`` is a
+    tuple (one entry per analyzer) of pytrees whose leaves carry a leading
+    shard dim; the shard count comes from that dim, NOT the mesh size, so
+    merging e.g. 8 persisted partition states on a 4-device mesh folds all
+    8. Inputs placed across the mesh are combined by XLA with on-ICI
+    collectives."""
+
+    def shards_of(tree) -> int:
+        leaves = jax.tree_util.tree_leaves(tree)
+        return int(leaves[0].shape[0]) if leaves else 0
+
+    def merge_program(stacked):
+        def take(i, tree):
+            return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+        out = []
+        for a, tree in zip(analyzers, stacked):
+            n = shards_of(tree)
+            acc = take(0, tree)
+            for i in range(1, n):
+                acc = a.merge(acc, take(i, tree))
+            out.append(acc)
+        return tuple(out)
+
+    return jax.jit(merge_program)(per_shard_states)

@@ -113,12 +113,22 @@ class VerificationSuite:
             metrics_repository=metrics_repository,
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
-            save_or_append_results_with_key=save_or_append_results_with_key,
+            # save AFTER evaluation (below), so anomaly checks never see the
+            # current point in their own history (reference
+            # `VerificationSuite.scala:121-139`)
+            save_or_append_results_with_key=None,
             batch_size=batch_size,
             monitor=monitor,
             sharding=sharding,
         )
-        return VerificationSuite.evaluate(checks, analysis_results)
+        result = VerificationSuite.evaluate(checks, analysis_results)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            from .runners.analysis_runner import _save_or_append
+
+            _save_or_append(
+                metrics_repository, save_or_append_results_with_key, analysis_results
+            )
+        return result
 
     @staticmethod
     def run_on_aggregated_states(
