@@ -329,16 +329,37 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
         mask = batch.row_mask
         values = col.values[mask]
         present = col.mask[mask]
-        keys = np.empty(len(values), dtype=object)
-        for i in range(len(values)):
-            if not present[i]:
-                keys[i] = NULL_FIELD_REPLACEMENT
+        if self.binning_func is None:
+            # vectorized: count raw PRESENT values first (cheap),
+            # Spark-string-cast only the distinct keys; nullness comes from
+            # the validity mask, never from the value (a genuine float NaN
+            # keys as 'nan', a null as NullValue)
+            present_values = values[present]
+            if present_values.dtype == object:
+                counts = pd.Series(present_values).value_counts(sort=False, dropna=False)
+                distinct, cnts = list(counts.index), counts.to_numpy()
             else:
-                v = values[i]
-                if self.binning_func is not None:
-                    v = self.binning_func(v)
-                keys[i] = _spark_string_cast(v) if v is not None else NULL_FIELD_REPLACEMENT
-        counts = pd.Series(keys).value_counts(sort=False)
+                distinct, cnts = np.unique(present_values, return_counts=True)
+            counts = pd.Series(
+                cnts, index=[_spark_string_cast(k) for k in distinct], dtype=np.int64
+            )
+            counts = counts.groupby(level=0, sort=False).sum()
+            num_null = int(len(values) - present.sum())
+            if num_null:
+                counts = counts.add(
+                    pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
+                ).astype(np.int64)
+        else:
+            keys = np.empty(len(values), dtype=object)
+            for i in range(len(values)):
+                if not present[i]:
+                    keys[i] = NULL_FIELD_REPLACEMENT
+                else:
+                    v = self.binning_func(values[i])
+                    keys[i] = (
+                        _spark_string_cast(v) if v is not None else NULL_FIELD_REPLACEMENT
+                    )
+            counts = pd.Series(keys).value_counts(sort=False)
         merged = state.frequencies.add(counts, fill_value=0).astype(np.int64)
         return FrequenciesAndNumRows(merged, state.num_rows + batch.num_rows, [self.column])
 

@@ -49,6 +49,18 @@ def is_one(value: float) -> bool:
     return value == 1.0
 
 
+def contained_in_predicate(column: str, allowed_values) -> str:
+    """Null-tolerant membership predicate shared by ``is_contained_in`` and
+    the categorical suggestion rules. Numeric literals stay numeric so
+    numeric columns can match their allowed set."""
+    literals = ", ".join(
+        repr(v) if isinstance(v, str) else repr(float(v))
+        if isinstance(v, float) else str(v)
+        for v in allowed_values
+    )
+    return f"({column} is None) or ({column} in [{literals}])"
+
+
 class Check:
     """(reference `checks/Check.scala:60-94`)."""
 
@@ -333,14 +345,7 @@ class Check:
         (lower_bound/upper_bound); non-null values must comply
         (reference `checks/Check.scala:844-943`)."""
         if allowed_values is not None:
-            # keep numeric literals numeric; only strings get quoted, else a
-            # numeric column could never match its stringified allowed set
-            literals = ", ".join(
-                repr(v) if isinstance(v, str) else repr(float(v))
-                if isinstance(v, float) else str(v)
-                for v in allowed_values
-            )
-            predicate = f"({column} is None) or ({column} in [{literals}])"
+            predicate = contained_in_predicate(column, allowed_values)
             return self.satisfies(
                 predicate,
                 f"{column} contained in {','.join(str(v) for v in allowed_values)}",

@@ -221,12 +221,28 @@ class Dataset:
 
     def with_column_cast_to_f64(self, name: str) -> "Dataset":
         """Replace a string column by its parsed-float64 version (profiler
-        pass-2 cast, reference `profiles/ColumnProfiler.scala:346-354`)."""
+        pass-2 cast, reference `profiles/ColumnProfiler.scala:346-354`).
+        Values the arrow cast rejects (e.g. "- 1.5", which the reference's
+        type-inference regex accepts) fall back to per-value parsing with
+        unparseable values becoming null (Spark cast semantics)."""
         import pyarrow.compute as pc
 
         col = self._table[name]
         idx = self._table.schema.get_field_index(name)
-        casted = pc.cast(col, pa.float64(), safe=False)
+        try:
+            casted = pc.cast(col, pa.float64(), safe=False)
+        except pa.ArrowInvalid:
+            def parse(v):
+                if v is None:
+                    return None
+                try:
+                    # Spark cast trims outer whitespace only; interior
+                    # spaces make the cast null
+                    return float(str(v).strip())
+                except ValueError:
+                    return None
+
+            casted = pa.array([parse(v) for v in col.to_pylist()], type=pa.float64())
         return Dataset(self._table.set_column(idx, name, casted))
 
     def random_split(self, train_fraction: float, seed: int = 0) -> ("Dataset", "Dataset"):

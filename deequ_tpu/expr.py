@@ -105,8 +105,27 @@ _BIN = {
 }
 
 
+def _coerce_object_numeric(a_arr: np.ndarray):
+    """SQL implicit cast of a string column for a numeric comparison:
+    parse to float64, unparseable/null -> NaN (behaves as null)."""
+    import pandas as pd
+
+    return pd.to_numeric(pd.Series(a_arr), errors="coerce").to_numpy(dtype=np.float64)
+
+
+def _is_number(x) -> bool:
+    return isinstance(x, (int, float, np.integer, np.floating)) and not isinstance(x, bool)
+
+
 def _eq(a, b) -> np.ndarray:
     a_arr, b_arr = np.asarray(a), np.asarray(b)
+    # SQL implicit cast: object column vs numeric scalar ('5' = 5 holds)
+    if a_arr.dtype == object and b_arr.shape == () and _is_number(b_arr.item()):
+        with np.errstate(invalid="ignore"):
+            return np.equal(_coerce_object_numeric(a_arr), b_arr)
+    if b_arr.dtype == object and a_arr.shape == () and _is_number(a_arr.item()):
+        with np.errstate(invalid="ignore"):
+            return np.equal(a_arr, _coerce_object_numeric(b_arr))
     if a_arr.dtype == object or b_arr.dtype == object:
         out = a_arr == b_arr
         return _as_bool(out) & ~_null_mask(a) & ~_null_mask(b if b_arr.shape else a)
@@ -116,6 +135,13 @@ def _eq(a, b) -> np.ndarray:
 
 def _num_cmp(a, b, op) -> np.ndarray:
     a_arr, b_arr = np.asarray(a), np.asarray(b)
+    # vectorized SQL implicit cast for object column vs numeric scalar
+    if a_arr.dtype == object and b_arr.shape == () and _is_number(b_arr.item()):
+        with np.errstate(invalid="ignore"):
+            return op(_coerce_object_numeric(a_arr), b_arr)
+    if b_arr.dtype == object and a_arr.shape == () and _is_number(a_arr.item()):
+        with np.errstate(invalid="ignore"):
+            return op(a_arr, _coerce_object_numeric(b_arr))
     if a_arr.dtype == object or b_arr.dtype == object:
         null = _null_mask(a_arr) | _null_mask(b_arr)
         a_f = np.where(null, None, a_arr) if a_arr.dtype == object else a_arr
@@ -129,7 +155,13 @@ def _num_cmp(a, b, op) -> np.ndarray:
             try:
                 out[i] = op(av, bv)
             except TypeError:
-                pass
+                # SQL implicit cast: string vs number comparison coerces the
+                # string side ("5" >= 0 is true in Spark); uncastable
+                # strings behave as null (False)
+                try:
+                    out[i] = op(float(av), float(bv))
+                except (TypeError, ValueError):
+                    pass
         return out
     with np.errstate(invalid="ignore"):
         return op(a, b)

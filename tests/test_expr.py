@@ -72,3 +72,13 @@ def test_inf_and_nan_pass_through_features():
     data = Dataset.from_arrow(pa.table({"x": pa.array([1.0, float("inf")])}))
     ctx = AnalysisRunner.do_analysis_run(data, [Maximum("x")])
     assert ctx.metric(Maximum("x")).value.get() == float("inf")
+
+
+class TestImplicitCoercion:
+    def test_string_column_numeric_comparisons(self):
+        from deequ_tpu.expr import evaluate_predicate
+
+        cols = {"s": np.array(["5", "7", "x", None], dtype=object)}
+        assert list(evaluate_predicate("s >= 5", cols, 4)) == [True, True, False, False]
+        assert list(evaluate_predicate("s == 5", cols, 4)) == [True, False, False, False]
+        assert list(evaluate_predicate("s == 7", cols, 4)) == [False, True, False, False]

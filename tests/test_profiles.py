@@ -1,0 +1,204 @@
+"""Column profiler tests — the analog of the reference
+`profiles/ColumnProfilerTest.scala` / `KLL/KLLProfileTest.scala`."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.data import Dataset
+from deequ_tpu.profiles import (
+    ColumnProfiler,
+    ColumnProfilerRunner,
+    NumericColumnProfile,
+    StandardColumnProfile,
+    determine_type,
+)
+from deequ_tpu.runners.engine import RunMonitor
+
+
+@pytest.fixture
+def mixed_data():
+    return Dataset.from_dict(
+        {
+            "item": ["1", "2", "3", "4", "5", "6"],
+            "att1": ["a", "b", "a", "a", "b", "a"],
+            "numeric_string": ["1.5", "2.5", "3.5", None, "5.5", "6.5"],
+            "int_string": ["1", "2", "3", "4", "5", "6"],
+            "num": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            "ints": [10, 20, 30, 40, 50, 60],
+            "bools": [True, False, True, False, True, True],
+        }
+    )
+
+
+class TestColumnProfiler:
+    def test_profile_types(self, mixed_data):
+        profiles = ColumnProfiler.profile(mixed_data)
+        assert profiles.num_records == 6
+        assert profiles["att1"].data_type == "String"
+        assert isinstance(profiles["att1"], StandardColumnProfile)
+        # string columns with numeric content are detected + promoted
+        assert profiles["numeric_string"].data_type == "Fractional"
+        assert isinstance(profiles["numeric_string"], NumericColumnProfile)
+        assert profiles["int_string"].data_type == "Integral"
+        assert profiles["item"].data_type == "Integral"
+        # non-string columns keep their known types
+        assert profiles["num"].data_type == "Fractional"
+        assert profiles["num"].is_data_type_inferred is False
+        assert profiles["ints"].data_type == "Integral"
+        assert profiles["bools"].data_type == "Boolean"
+
+    def test_numeric_statistics(self, mixed_data):
+        profiles = ColumnProfiler.profile(mixed_data)
+        p = profiles["num"]
+        assert p.mean == pytest.approx(3.5)
+        assert p.minimum == 1.0
+        assert p.maximum == 6.0
+        assert p.sum == 21.0
+        assert p.std_dev == pytest.approx(np.std([1, 2, 3, 4, 5, 6]))
+        assert len(p.approx_percentiles) == 100
+        assert p.approx_percentiles[0] == 1.0
+        assert p.approx_percentiles[-1] == 6.0
+        # casted string column gets numeric stats too (nulls excluded)
+        ps = profiles["numeric_string"]
+        assert ps.mean == pytest.approx((1.5 + 2.5 + 3.5 + 5.5 + 6.5) / 5)
+        assert ps.completeness == pytest.approx(5 / 6)
+
+    def test_histograms_low_cardinality(self, mixed_data):
+        profiles = ColumnProfiler.profile(mixed_data)
+        h = profiles["att1"].histogram
+        assert h is not None
+        assert h["a"].absolute == 4
+        assert h["b"].absolute == 2
+        assert h["a"].ratio == pytest.approx(4 / 6)
+        # booleans histogrammed as their string forms
+        hb = profiles["bools"].histogram
+        assert hb is not None
+        assert hb["true"].absolute == 4
+
+    def test_histogram_threshold(self):
+        data = Dataset.from_dict({"many": [str(i) for i in range(300)]})
+        profiles = ColumnProfiler.profile(data, low_cardinality_histogram_threshold=120)
+        assert profiles["many"].histogram is None
+        profiles2 = ColumnProfiler.profile(data, low_cardinality_histogram_threshold=1000)
+        assert profiles2["many"].histogram is not None
+
+    def test_pass_count(self, mixed_data):
+        """Full profile in <= 3 data passes; the third only exists when a
+        casted numeric-string column also needs a histogram (reference
+        always needs 3, `ColumnProfiler.scala:57-68`)."""
+        mon = RunMonitor()
+        ColumnProfiler.profile(mixed_data, monitor=mon)
+        assert mon.passes == 3  # mixed_data has casted histogram columns
+        mon2 = RunMonitor()
+        data = Dataset.from_dict({"x": [1.0, 2.0], "s": ["a", "b"]})
+        ColumnProfiler.profile(data, monitor=mon2)
+        assert mon2.passes == 2  # no casted histogram columns -> 2 passes
+
+    def test_histogram_keys_are_original_strings(self):
+        """Numeric-string histograms key by ORIGINAL values, not the casted
+        floats (reference pass 3 reads the raw data)."""
+        data = Dataset.from_dict({"int_string": ["1", "2", "3", "1"]})
+        profiles = ColumnProfiler.profile(data)
+        hist = profiles["int_string"].histogram
+        assert set(hist.values.keys()) == {"1", "2", "3"}
+        assert hist["1"].absolute == 2
+
+    def test_histogram_nan_vs_null(self):
+        import pyarrow as pa
+
+        data = Dataset.from_arrow(
+            pa.table({"f": pa.array([1.0, float("nan"), None], type=pa.float64())})
+        )
+        from deequ_tpu.analyzers import Histogram
+        from deequ_tpu.runners import AnalysisRunner
+
+        ctx = AnalysisRunner.do_analysis_run(data, [Histogram("f")])
+        hist = ctx.metric(Histogram("f")).value.get()
+        assert hist["NullValue"].absolute == 1
+        assert hist["nan"].absolute == 1
+        assert hist["1.0"].absolute == 1
+
+    def test_predefined_types_not_inferred(self, mixed_data):
+        profiles = ColumnProfiler.profile(
+            mixed_data, predefined_types={"int_string": "Integral"}
+        )
+        assert profiles["int_string"].is_data_type_inferred is False
+
+    def test_restrict_to_columns(self, mixed_data):
+        profiles = ColumnProfiler.profile(mixed_data, restrict_to_columns=["num"])
+        assert set(profiles.profiles) == {"num"}
+        with pytest.raises(ValueError):
+            ColumnProfiler.profile(mixed_data, restrict_to_columns=["nope"])
+
+    def test_predefined_types(self, mixed_data):
+        profiles = ColumnProfiler.profile(
+            mixed_data, predefined_types={"int_string": "String"}
+        )
+        assert profiles["int_string"].data_type == "String"
+        assert isinstance(profiles["int_string"], StandardColumnProfile)
+
+    def test_runner_builder_and_json(self, mixed_data, tmp_path):
+        path = str(tmp_path / "profiles.json")
+        profiles = (
+            ColumnProfilerRunner.on_data(mixed_data)
+            .restrict_to_columns(["num", "att1"])
+            .save_column_profiles_json_to_path(path)
+            .run()
+        )
+        payload = json.loads(open(path).read())
+        by_col = {c["column"]: c for c in payload["columns"]}
+        assert by_col["num"]["mean"] == pytest.approx(3.5)
+        assert by_col["att1"]["dataType"] == "String"
+        assert {h["value"] for h in by_col["att1"]["histogram"]} == {"a", "b"}
+
+    def test_repository_reuse(self, mixed_data):
+        from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(1)
+        p1 = ColumnProfiler.profile(
+            mixed_data,
+            metrics_repository=repo,
+            save_in_metrics_repository_using_key=key,
+        )
+        mon = RunMonitor()
+        p2 = ColumnProfiler.profile(
+            mixed_data,
+            metrics_repository=repo,
+            reuse_existing_results_using_key=key,
+            monitor=mon,
+        )
+        assert mon.passes == 0  # fully served from the repository
+        assert p2["num"].mean == p1["num"].mean
+
+    def test_kll_in_profile(self, mixed_data):
+        from deequ_tpu.analyzers import KLLParameters
+
+        profiles = ColumnProfiler.profile(
+            mixed_data, kll_parameters=KLLParameters(512, 0.64, 3)
+        )
+        kll = profiles["num"].kll
+        assert kll is not None
+        assert len(kll.buckets) == 3
+        assert sum(b.count for b in kll.buckets) == 6
+
+
+class TestDetermineType:
+    def _dist(self, **counts):
+        from deequ_tpu.metrics import Distribution, DistributionValue
+
+        total = sum(counts.values()) or 1
+        return Distribution(
+            {k: DistributionValue(v, v / total) for k, v in counts.items()},
+            number_of_bins=len(counts),
+        )
+
+    def test_decision_tree(self):
+        assert determine_type(self._dist(Unknown=5)) == "Unknown"
+        assert determine_type(self._dist(String=1, Integral=5)) == "String"
+        assert determine_type(self._dist(Boolean=1, Integral=1)) == "String"
+        assert determine_type(self._dist(Boolean=3, Unknown=1)) == "Boolean"
+        assert determine_type(self._dist(Fractional=1, Integral=5)) == "Fractional"
+        assert determine_type(self._dist(Integral=5, Unknown=2)) == "Integral"

@@ -1,0 +1,214 @@
+"""Constraint suggestion tests — the analog of the reference
+`suggestions/*Test.scala` + `ConstraintSuggestionsIntegrationTest.scala`."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.data import Dataset
+from deequ_tpu.suggestions import ConstraintSuggestionRunner, Rules
+from deequ_tpu.suggestions.rules import (
+    CategoricalRangeRule,
+    CompleteIfCompleteRule,
+    FractionalCategoricalRangeRule,
+    NonNegativeNumbersRule,
+    RetainCompletenessRule,
+    RetainTypeRule,
+    UniqueIfApproximatelyUniqueRule,
+)
+
+
+@pytest.fixture
+def suggestion_data():
+    n = 200
+    rng = np.random.default_rng(0)
+    import pyarrow as pa
+
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "id": pa.array([str(i) for i in range(n)]),
+                "status": pa.array(
+                    [("ACTIVE", "INACTIVE", "DELETED")[i % 3] for i in range(n)]
+                ),
+                "mostly_complete": pa.array(
+                    [float(i) if i % 10 else None for i in range(n)]
+                ),
+                "count_str": pa.array([str(i % 50) for i in range(n)]),
+                "views": pa.array(rng.integers(0, 100, n)),
+            }
+        )
+    )
+
+
+class TestRules:
+    def test_default_set(self):
+        names = [type(r).__name__ for r in Rules.DEFAULT]
+        assert names == [
+            "CompleteIfCompleteRule",
+            "RetainCompletenessRule",
+            "RetainTypeRule",
+            "CategoricalRangeRule",
+            "FractionalCategoricalRangeRule",
+            "NonNegativeNumbersRule",
+        ]
+
+    def test_end_to_end_suggestions(self, suggestion_data):
+        result = (
+            ConstraintSuggestionRunner.on_data(suggestion_data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .run()
+        )
+        assert result.num_records == 200
+        by_col = result.constraint_suggestions
+        # complete columns -> isComplete
+        codes = [s.code_for_constraint for s in by_col.get("id", [])]
+        assert any("is_complete" in c for c in codes)
+        # categorical string column -> is_contained_in
+        status_codes = [s.code_for_constraint for s in by_col.get("status", [])]
+        assert any("is_contained_in" in c for c in status_codes)
+        # incomplete column -> has_completeness with lower bound
+        mc = [s.code_for_constraint for s in by_col.get("mostly_complete", [])]
+        assert any("has_completeness" in c for c in mc)
+        # numeric string column -> type constraint
+        cs = [s.code_for_constraint for s in by_col.get("count_str", [])]
+        assert any("has_data_type" in c for c in cs)
+        # non-negative ints
+        vw = [s.code_for_constraint for s in by_col.get("views", [])]
+        assert any("is_non_negative" in c for c in vw)
+
+    def test_suggested_constraints_evaluate_cleanly(self, suggestion_data):
+        """Applying the suggested constraints to the SAME data must succeed
+        (suggestions describe the data)."""
+        from deequ_tpu.checks import Check, CheckLevel
+        from deequ_tpu.constraints import ConstraintStatus
+        from deequ_tpu.verification import VerificationSuite
+
+        result = (
+            ConstraintSuggestionRunner.on_data(suggestion_data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .run()
+        )
+        check = Check(CheckLevel.ERROR, "suggested")
+        for s in result.all_suggestions:
+            check = check.add_constraint(s.constraint)
+        verification = VerificationSuite.on_data(suggestion_data).add_check(check).run()
+        failures = [
+            (str(cr.constraint), cr.message)
+            for r in verification.check_results.values()
+            for cr in r.constraint_results
+            if cr.status == ConstraintStatus.FAILURE
+        ]
+        # known reference wart carried over: NonNegativeNumbersRule emits
+        # `col >= 0` whose compliance counts nulls as non-compliant, so it
+        # fails on incomplete columns (reference
+        # `rules/NonNegativeNumbersRule.scala` has the same behavior)
+        unexpected = [f for f in failures if "mostly_complete" not in f[0]]
+        assert unexpected == []
+        assert len(failures) <= 1
+
+    def test_train_test_split_evaluation(self, suggestion_data, tmp_path):
+        eval_path = str(tmp_path / "eval.json")
+        sugg_path = str(tmp_path / "suggestions.json")
+        result = (
+            ConstraintSuggestionRunner.on_data(suggestion_data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .use_train_test_split_with_testset_ratio(0.3, testset_split_random_seed=7)
+            .save_constraint_suggestions_json_to_path(sugg_path)
+            .save_evaluation_results_json_to_path(eval_path)
+            .run()
+        )
+        assert result.verification_result is not None
+        payload = json.loads(open(eval_path).read())
+        assert len(payload["constraint_suggestions"]) == len(result.all_suggestions)
+        sugg = json.loads(open(sugg_path).read())
+        assert {s["column_name"] for s in sugg["constraint_suggestions"]}
+
+    def test_invalid_testset_ratio(self, suggestion_data):
+        with pytest.raises(ValueError):
+            ConstraintSuggestionRunner.on_data(
+                suggestion_data
+            ).add_constraint_rules(Rules.DEFAULT).use_train_test_split_with_testset_ratio(
+                1.5
+            ).run()
+
+
+class TestIndividualRules:
+    def _profile(self, **kwargs):
+        from deequ_tpu.profiles import NumericColumnProfile, StandardColumnProfile
+
+        numeric = kwargs.pop("numeric", False)
+        defaults = dict(
+            column="col",
+            completeness=1.0,
+            approximate_num_distinct_values=10,
+            data_type="String",
+            is_data_type_inferred=True,
+        )
+        defaults.update(kwargs)
+        cls = NumericColumnProfile if numeric else StandardColumnProfile
+        return cls(**defaults)
+
+    def test_complete_if_complete(self):
+        rule = CompleteIfCompleteRule()
+        assert rule.should_be_applied(self._profile(completeness=1.0), 100)
+        assert not rule.should_be_applied(self._profile(completeness=0.99), 100)
+
+    def test_retain_completeness_bounds(self):
+        rule = RetainCompletenessRule()
+        assert rule.should_be_applied(self._profile(completeness=0.5), 100)
+        assert not rule.should_be_applied(self._profile(completeness=0.1), 100)
+        assert not rule.should_be_applied(self._profile(completeness=1.0), 100)
+        s = rule.candidate(self._profile(completeness=0.5), 100)
+        # evaluate the generated assertion: target = 0.5 - 1.96*sqrt(0.25/100)
+        target = 0.40  # rounded down to 2 decimals
+        assert f"{target}" in s.code_for_constraint
+
+    def test_retain_type(self):
+        rule = RetainTypeRule()
+        assert rule.should_be_applied(
+            self._profile(data_type="Integral", is_data_type_inferred=True), 10
+        )
+        assert not rule.should_be_applied(
+            self._profile(data_type="Integral", is_data_type_inferred=False), 10
+        )
+        assert not rule.should_be_applied(
+            self._profile(data_type="String", is_data_type_inferred=True), 10
+        )
+
+    def test_categorical_range_rule(self):
+        from deequ_tpu.metrics import Distribution, DistributionValue
+
+        hist = Distribution(
+            {"a": DistributionValue(50, 0.5), "b": DistributionValue(50, 0.5)}, 2
+        )
+        rule = CategoricalRangeRule()
+        assert rule.should_be_applied(self._profile(histogram=hist), 100)
+        # mostly-unique histogram -> not applied
+        unique_hist = Distribution(
+            {str(i): DistributionValue(1, 0.01) for i in range(100)}, 100
+        )
+        assert not rule.should_be_applied(self._profile(histogram=unique_hist), 100)
+
+    def test_non_negative_rule(self):
+        rule = NonNegativeNumbersRule()
+        assert rule.should_be_applied(
+            self._profile(numeric=True, data_type="Integral", minimum=0.0), 10
+        )
+        assert not rule.should_be_applied(
+            self._profile(numeric=True, data_type="Integral", minimum=-1.0), 10
+        )
+        assert not rule.should_be_applied(self._profile(), 10)
+
+    def test_unique_if_approximately_unique(self):
+        rule = UniqueIfApproximatelyUniqueRule()
+        assert rule.should_be_applied(
+            self._profile(approximate_num_distinct_values=97), 100
+        )
+        assert not rule.should_be_applied(
+            self._profile(approximate_num_distinct_values=50), 100
+        )
+        assert not rule.should_be_applied(
+            self._profile(approximate_num_distinct_values=97, completeness=0.9), 100
+        )
