@@ -82,6 +82,17 @@ _FUNCTIONS: Dict[str, Callable] = {
 
 def _neq(a, b) -> np.ndarray:
     # null on either side -> False (3-valued logic collapsed), like NotIn
+    a_arr, b_arr = np.asarray(a), np.asarray(b)
+    # implicit-cast path: uncastable strings behave as null (False), same
+    # as the == / < / > coercion
+    if a_arr.dtype == object and b_arr.shape == () and _is_number(b_arr.item()):
+        c = _coerce_object_numeric(a_arr)
+        with np.errstate(invalid="ignore"):
+            return np.not_equal(c, b_arr) & ~np.isnan(c)
+    if b_arr.dtype == object and a_arr.shape == () and _is_number(a_arr.item()):
+        c = _coerce_object_numeric(b_arr)
+        with np.errstate(invalid="ignore"):
+            return np.not_equal(a_arr, c) & ~np.isnan(c)
     return ~_eq(a, b) & ~_null_mask(a) & ~_null_mask(b)
 
 

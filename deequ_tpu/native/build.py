@@ -1,0 +1,46 @@
+"""Build the native host-kernel shared library.
+
+Usage: ``python -m deequ_tpu.native.build``; `lib.py` also invokes this
+automatically on first use (set DEEQU_TPU_NO_NATIVE=1 to disable).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "src", "host_kernels.cpp")
+LIBRARY = os.path.join(_DIR, "_host_kernels.so")
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    if (
+        not force
+        and os.path.exists(LIBRARY)
+        and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)
+    ):
+        return LIBRARY
+    # compile to a temp path and rename into place so concurrent importers
+    # never dlopen a half-written library
+    tmp = f"{LIBRARY}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", tmp, SOURCE,
+    ]
+    try:
+        result = subprocess.run(cmd, capture_output=True, text=True)
+        if result.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{result.stderr}")
+        os.replace(tmp, LIBRARY)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return LIBRARY
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(f"built {path}")

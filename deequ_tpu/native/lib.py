@@ -1,0 +1,90 @@
+"""ctypes bindings for the native host kernels.
+
+Object arrays of python strings are converted ONCE per batch to Arrow
+large-string layout (concatenated UTF-8 buffer + int64 offsets — a C-speed
+conversion via pyarrow), then each kernel runs a single C++ pass over the
+buffers. Falls back to pure Python upstream if anything here fails to load.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from .build import build
+
+if os.environ.get("DEEQU_TPU_NO_NATIVE"):
+    raise ImportError("native kernels disabled via DEEQU_TPU_NO_NATIVE")
+
+_lib = ctypes.CDLL(build())
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+_lib.xxhash64_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u64p]
+_lib.classify_types_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, _i32p]
+_lib.string_lengths_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, _i32p]
+
+
+def _arrow_layout(values: np.ndarray):
+    """(data u8[:], offsets i64[n+1], valid u8[n]) from an object array of
+    str/None."""
+    arr = pa.array(values, type=pa.large_string(), from_pandas=True)
+    buffers = arr.buffers()  # [validity, offsets, data]
+    n = len(arr)
+    offsets = np.frombuffer(buffers[1], dtype=np.int64, count=n + 1 + arr.offset)
+    if arr.offset:
+        offsets = offsets[arr.offset:]
+    data_buf = buffers[2]
+    data = (
+        np.frombuffer(data_buf, dtype=np.uint8)
+        if data_buf is not None and len(data_buf) > 0
+        else np.zeros(1, dtype=np.uint8)
+    )
+    if arr.null_count:
+        valid = np.asarray(arr.is_valid()).astype(np.uint8)
+    else:
+        valid = np.ones(n, dtype=np.uint8)
+    return data, np.ascontiguousarray(offsets), valid
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctype)
+
+
+def native_xxhash64_strings(values: np.ndarray, seed: int) -> np.ndarray:
+    data, offsets, valid = _arrow_layout(values)
+    n = len(values)
+    out = np.empty(n, dtype=np.uint64)
+    _lib.xxhash64_batch(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p),
+        n, ctypes.c_uint64(seed), _ptr(out, _u64p),
+    )
+    return out
+
+
+def native_classify_types(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    data, offsets, valid = _arrow_layout(values)
+    valid = valid & np.asarray(mask, dtype=np.uint8)
+    n = len(values)
+    out = np.empty(n, dtype=np.int32)
+    _lib.classify_types_batch(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p), n, _ptr(out, _i32p)
+    )
+    return out
+
+
+def native_string_lengths(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    data, offsets, valid = _arrow_layout(values)
+    valid = valid & np.asarray(mask, dtype=np.uint8)
+    n = len(values)
+    out = np.empty(n, dtype=np.int32)
+    _lib.string_lengths_batch(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p), n, _ptr(out, _i32p)
+    )
+    return out

@@ -1,0 +1,178 @@
+// Native host kernels for the string-typed hot loops of the scan frontend.
+//
+// The reference's native tier is its set of Catalyst ImperativeAggregate /
+// UDAF kernels doing per-row buffer updates inside Spark executors
+// (reference `analyzers/catalyst/StatefulHyperloglogPlus.scala:89-115`,
+// `StatefulDataType.scala:26-83`). Here the device tier is XLA; this C++
+// tier covers the host-side per-value string work the device cannot do:
+// xxHash64 batch hashing (HLL ingest), type classification (DataType
+// analyzer) and UTF-8 length counting (Min/MaxLength), all operating on
+// Arrow-layout buffers (concatenated UTF-8 bytes + offsets) in one pass.
+//
+// Build: python -m deequ_tpu.native.build  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// xxHash64 (public algorithm; must match deequ_tpu/ops/hashing.py and
+// Spark's XxHash64Function bit-for-bit)
+// ---------------------------------------------------------------------------
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+static uint64_t xxh64(const uint8_t* data, int64_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed;
+    uint64_t v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = rotl64(v1 + read64(p) * P2, 31) * P1; p += 8;
+      v2 = rotl64(v2 + read64(p) * P2, 31) * P1; p += 8;
+      v3 = rotl64(v3 + read64(p) * P2, 31) * P1; p += 8;
+      v4 = rotl64(v4 + read64(p) * P2, 31) * P1; p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = (h ^ (rotl64(v1 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v2 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v3 * P2, 31) * P1)) * P1 + P4;
+    h = (h ^ (rotl64(v4 * P2, 31) * P1)) * P1 + P4;
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h = rotl64(h ^ (rotl64(read64(p) * P2, 31) * P1), 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h = rotl64(h ^ ((uint64_t)read32(p) * P1), 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h = rotl64(h ^ ((uint64_t)(*p) * P5), 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// hash n strings given arrow large-string layout; null/invalid -> seed
+void xxhash64_batch(const uint8_t* data, const int64_t* offsets,
+                    const uint8_t* valid, int64_t n, uint64_t seed,
+                    uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = seed;
+      continue;
+    }
+    out[i] = xxh64(data + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// type classification (reference regexes,
+// `analyzers/catalyst/StatefulDataType.scala:36-38`):
+//   FRACTIONAL: ^(-|\+)? ?\d*\.\d*$
+//   INTEGRAL:   ^(-|\+)? ?\d*$
+//   BOOLEAN:    ^(true|false)$
+// decision order: null -> fractional -> integral -> boolean -> string
+// codes: 0=null/unknown 1=fractional 2=integral 3=boolean 4=string
+// ---------------------------------------------------------------------------
+
+static inline bool match_numericish(const uint8_t* s, int64_t len, bool* fractional) {
+  int64_t i = 0;
+  if (i < len && (s[i] == '-' || s[i] == '+')) ++i;
+  if (i < len && s[i] == ' ') ++i;  // the reference regex admits one space
+  int64_t digits_before = 0;
+  while (i < len && s[i] >= '0' && s[i] <= '9') { ++i; ++digits_before; }
+  if (i == len) {           // integral (digits may be empty, as in the regex)
+    *fractional = false;
+    return true;
+  }
+  if (s[i] != '.') return false;
+  ++i;
+  while (i < len && s[i] >= '0' && s[i] <= '9') ++i;
+  if (i != len) return false;
+  *fractional = true;       // digits on either side of '.' may be empty
+  return true;
+}
+
+static inline bool match_boolean(const uint8_t* s, int64_t len) {
+  return (len == 4 && std::memcmp(s, "true", 4) == 0) ||
+         (len == 5 && std::memcmp(s, "false", 5) == 0);
+}
+
+void classify_types_batch(const uint8_t* data, const int64_t* offsets,
+                          const uint8_t* valid, int64_t n, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    const uint8_t* s = data + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    bool fractional = false;
+    if (match_numericish(s, len, &fractional)) {
+      out[i] = fractional ? 1 : 2;
+    } else if (match_boolean(s, len)) {
+      out[i] = 3;
+    } else {
+      out[i] = 4;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// UTF-8 codepoint lengths (matches python len(str)); null -> 0
+// ---------------------------------------------------------------------------
+
+void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
+                          const uint8_t* valid, int64_t n, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    const uint8_t* s = data + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int32_t count = 0;
+    for (int64_t j = 0; j < len; ++j) {
+      if ((s[j] & 0xC0) != 0x80) ++count;  // count non-continuation bytes
+    }
+    out[i] = count;
+  }
+}
+
+}  // extern "C"

@@ -25,10 +25,12 @@ from ..ops.hll import hll_features
 
 # reference regexes (`analyzers/catalyst/StatefulDataType.scala:36-38`);
 # decision order: null -> fractional -> integral -> boolean -> string
-# (`StatefulDataType.update`, same file)
-_FRACTIONAL_RE = re.compile(r"^(-|\+)? ?\d*\.\d*$")
-_INTEGRAL_RE = re.compile(r"^(-|\+)? ?\d*$")
-_BOOLEAN_RE = re.compile(r"^(true|false)$")
+# (`StatefulDataType.update`, same file). re.ASCII + fullmatch reproduce the
+# Java Matcher semantics (ASCII \d, whole-string match incl. no trailing
+# newline) and keep the native C++ kernel bit-identical.
+_FRACTIONAL_RE = re.compile(r"(-|\+)? ?\d*\.\d*", re.ASCII)
+_INTEGRAL_RE = re.compile(r"(-|\+)? ?\d*", re.ASCII)
+_BOOLEAN_RE = re.compile(r"true|false")
 
 TYPE_NULL, TYPE_FRACTIONAL, TYPE_INTEGRAL, TYPE_BOOLEAN, TYPE_STRING = range(5)
 
@@ -51,11 +53,11 @@ def classify_type_codes(values: np.ndarray, mask: np.ndarray, kind: ColumnKind) 
             v = values[i]
             if v is None:
                 continue
-            if _FRACTIONAL_RE.match(v):
+            if _FRACTIONAL_RE.fullmatch(v):
                 out[i] = TYPE_FRACTIONAL
-            elif _INTEGRAL_RE.match(v):
+            elif _INTEGRAL_RE.fullmatch(v):
                 out[i] = TYPE_INTEGRAL
-            elif _BOOLEAN_RE.match(v):
+            elif _BOOLEAN_RE.fullmatch(v):
                 out[i] = TYPE_BOOLEAN
             else:
                 out[i] = TYPE_STRING

@@ -94,7 +94,7 @@ class RetainCompletenessRule(ConstraintRule):
         n = max(num_records, 1)
         z = 1.96
         target = _round_down_2(p - z * math.sqrt(p * (1 - p) / n))
-        bound_percent = int((1.0 - target) * 100)
+        bound_percent = round((1.0 - target) * 100)
         return ConstraintSuggestion(
             completeness_constraint(profile.column, lambda v, t=target: v >= t),
             profile.column,

@@ -82,3 +82,10 @@ class TestImplicitCoercion:
         assert list(evaluate_predicate("s >= 5", cols, 4)) == [True, True, False, False]
         assert list(evaluate_predicate("s == 5", cols, 4)) == [True, False, False, False]
         assert list(evaluate_predicate("s == 7", cols, 4)) == [False, True, False, False]
+
+    def test_neq_uncastable_is_null(self):
+        from deequ_tpu.expr import evaluate_predicate
+
+        cols = {"s": np.array(["x", "5", None], dtype=object)}
+        assert list(evaluate_predicate("s != 5", cols, 3)) == [False, False, False]
+        assert list(evaluate_predicate("s != 7", cols, 3)) == [False, True, False]
