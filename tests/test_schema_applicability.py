@@ -1,0 +1,136 @@
+"""RowLevelSchemaValidator + Applicability tests — the analog of
+`schema/RowLevelSchemaValidatorTest.scala` and
+`analyzers/applicability/ApplicabilityTest.scala`."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.applicability import Applicability, generate_random_data
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.data import ColumnKind, ColumnSchema, Dataset, Schema
+from deequ_tpu.schema import (
+    RowLevelSchema,
+    RowLevelSchemaValidator,
+)
+
+
+class TestRowLevelSchemaValidator:
+    def test_int_validation_and_cast(self):
+        data = Dataset.from_dict(
+            {"id": ["1", "2", "not-a-number", "4", None], "name": list("abcde")}
+        )
+        schema = RowLevelSchema().with_int_column("id", is_nullable=False)
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 3
+        assert result.num_invalid_rows == 2
+        valid = result.valid_rows.to_pandas()
+        assert list(valid["id"]) == [1, 2, 4]
+        assert result.valid_rows.schema["id"].kind == ColumnKind.INTEGRAL
+        invalid = result.invalid_rows.to_pandas()
+        assert set(invalid["name"]) == {"c", "e"}
+
+    def test_int_bounds(self):
+        data = Dataset.from_dict({"v": ["5", "15", "25"]})
+        schema = RowLevelSchema().with_int_column("v", min_value=10, max_value=20)
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 1
+        assert list(result.valid_rows.to_pandas()["v"]) == [15]
+
+    def test_string_constraints(self):
+        data = Dataset.from_dict({"code": ["AB", "ABC", "ABCD", "xy", None]})
+        schema = RowLevelSchema().with_string_column(
+            "code", min_length=2, max_length=3, matches="^[A-Z]+$"
+        )
+        result = RowLevelSchemaValidator.validate(data, schema)
+        # AB, ABC pass; ABCD too long; xy lowercase; null allowed (nullable)
+        assert result.num_valid_rows == 3
+
+    def test_decimal(self):
+        data = Dataset.from_dict({"d": ["12.34", "123456.7", "abc"]})
+        schema = RowLevelSchema().with_decimal_column("d", precision=6, scale=2)
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 1
+        assert list(result.valid_rows.to_pandas()["d"]) == [12.34]
+
+    def test_timestamp(self):
+        data = Dataset.from_dict(
+            {"ts": ["2024-01-31 10:30:00", "not a date", "2024-13-99 99:99:99"]}
+        )
+        schema = RowLevelSchema().with_timestamp_column("ts", mask="yyyy-MM-dd HH:mm:ss")
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 1
+        assert result.valid_rows.schema["ts"].kind == ColumnKind.TIMESTAMP
+
+    def test_non_nullable(self):
+        data = Dataset.from_dict({"x": ["a", None, "b"]})
+        schema = RowLevelSchema().with_string_column("x", is_nullable=False)
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 2
+
+    def test_multi_column_cnf(self):
+        data = Dataset.from_dict(
+            {
+                "id": ["1", "2", "x"],
+                "name": ["alice", "bob", "carol"],
+            }
+        )
+        schema = (
+            RowLevelSchema()
+            .with_int_column("id", is_nullable=False)
+            .with_string_column("name", max_length=5)
+        )
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 2
+
+
+class TestApplicability:
+    def _schema(self):
+        return Schema(
+            [
+                ColumnSchema("num", ColumnKind.FRACTIONAL),
+                ColumnSchema("count", ColumnKind.INTEGRAL),
+                ColumnSchema("name", ColumnKind.STRING),
+                ColumnSchema("flag", ColumnKind.BOOLEAN),
+            ]
+        )
+
+    def test_generate_random_data(self):
+        data = generate_random_data(self._schema(), 500)
+        assert data.num_rows == 500
+        assert data.schema["num"].kind == ColumnKind.FRACTIONAL
+        assert data.schema["name"].kind == ColumnKind.STRING
+
+    def test_applicable_check(self):
+        check = (
+            Check(CheckLevel.ERROR, "ok")
+            .has_size(lambda v: True)
+            .has_mean("num", lambda v: True)
+            .is_complete("name")
+        )
+        result = Applicability.is_applicable_check(check, self._schema())
+        assert result.is_applicable
+        assert all(result.constraint_applicabilities.values())
+
+    def test_inapplicable_check(self):
+        check = (
+            Check(CheckLevel.ERROR, "bad")
+            .has_mean("name", lambda v: True)  # mean over a string column
+            .has_mean("missing_col", lambda v: True)
+        )
+        result = Applicability.is_applicable_check(check, self._schema())
+        assert not result.is_applicable
+        assert len(result.failures) == 2
+        inapplicable = [
+            c for c, ok in result.constraint_applicabilities.items() if not ok
+        ]
+        assert len(inapplicable) == 2
+
+    def test_analyzers_applicability(self):
+        from deequ_tpu.analyzers import Completeness, Mean
+
+        result = Applicability.is_applicable_analyzers(
+            [Mean("num"), Completeness("name")], self._schema()
+        )
+        assert result.is_applicable
+        bad = Applicability.is_applicable_analyzers([Mean("name")], self._schema())
+        assert not bad.is_applicable
