@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -91,29 +92,99 @@ def sharded_update(analyzers: Sequence[Any], mesh: Mesh):
 
 def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_states):
     """Fold per-shard state pytrees with each analyzer's semigroup ``merge``
-    in ONE jit'd device program — the treeReduce analog (reference
-    `analyzers/runners/KLLRunner.scala:104-112`). ``per_shard_states`` is a
-    tuple (one entry per analyzer) of pytrees whose leaves carry a leading
-    shard dim; the shard count comes from that dim, NOT the mesh size, so
-    merging e.g. 8 persisted partition states on a 4-device mesh folds all
-    8. Inputs placed across the mesh are combined by XLA with on-ICI
-    collectives."""
+    in ONE collective device program — the treeReduce analog (reference
+    `analyzers/runners/KLLRunner.scala:104-112`).
+
+    ``per_shard_states`` is a tuple (one entry per analyzer) of pytrees whose
+    leaves carry a leading shard dim; the shard count comes from that dim,
+    NOT the mesh size, so merging e.g. 8 persisted partition states on a
+    4-device mesh folds all 8.
+
+    Execution shape (a real tree reduction, not a sequential fold):
+
+    1. pad the shard dim to a multiple of the mesh size with identity states
+       (``init_state`` — every state merge is zero-count safe) and lay the
+       shards out over the mesh axis, ``k`` local shards per device;
+    2. inside ``shard_map``, each device folds its ``k`` local shards;
+    3. cross-device combine: a log2(n)-round **butterfly** — each round
+       ``lax.ppermute``s the partial state to the XOR partner and merges, so
+       every round halves the number of distinct partials and all traffic
+       rides ICI (falls back to one ``all_gather`` + local fold when the
+       mesh size is not a power of two).
+    """
+    n_dev = int(mesh.devices.size)
 
     def shards_of(tree) -> int:
         leaves = jax.tree_util.tree_leaves(tree)
         return int(leaves[0].shape[0]) if leaves else 0
 
-    def merge_program(stacked):
-        def take(i, tree):
-            return jax.tree_util.tree_map(lambda x: x[i], tree)
+    total = max((shards_of(t) for t in per_shard_states), default=0)
+    if total == 0:
+        # zero shards: the merge of an empty set is the identity state
+        return tuple(a.init_state() for a in analyzers)
+    k = -(-total // n_dev)  # local shards per device after padding
 
+    # pad with identity states so the shard dim is exactly n_dev * k
+    padded = []
+    for a, tree in zip(analyzers, per_shard_states):
+        n = shards_of(tree)
+        pad = n_dev * k - n
+        if pad:
+            ident = a.init_state()
+
+            def pad_leaf(x, i):
+                tile = jnp.broadcast_to(jnp.asarray(i)[None], (pad,) + jnp.asarray(i).shape)
+                return jnp.concatenate([jnp.asarray(x), tile.astype(jnp.asarray(x).dtype)], axis=0)
+
+            tree = jax.tree_util.tree_map(pad_leaf, tree, ident)
+        padded.append(tree)
+    padded = tuple(padded)
+
+    shard_spec = jax.tree_util.tree_map(
+        lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), padded
+    )
+    pow2 = (n_dev & (n_dev - 1)) == 0
+
+    def merge_program(stacked):
         out = []
         for a, tree in zip(analyzers, stacked):
-            n = shards_of(tree)
-            acc = take(0, tree)
-            for i in range(1, n):
-                acc = a.merge(acc, take(i, tree))
-            out.append(acc)
+            # 2) local fold of the k resident shards
+            acc = jax.tree_util.tree_map(lambda x: x[0], tree)
+            for i in range(1, k):
+                acc = a.merge(acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], tree))
+            # 3) cross-device combine
+            if n_dev > 1 and pow2:
+                shift = 1
+                while shift < n_dev:
+                    perm = [(i, i ^ shift) for i in range(n_dev)]
+                    partner = jax.tree_util.tree_map(
+                        lambda x: jax.lax.ppermute(x, ROW_AXIS, perm), acc
+                    )
+                    acc = a.merge(acc, partner)
+                    shift <<= 1
+            elif n_dev > 1:
+                gathered = jax.tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(x, ROW_AXIS), acc
+                )
+                acc = jax.tree_util.tree_map(lambda x: x[0], gathered)
+                for i in range(1, n_dev):
+                    acc = a.merge(
+                        acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], gathered)
+                    )
+            out.append(jax.tree_util.tree_map(lambda x: x[None], acc))
         return tuple(out)
 
-    return jax.jit(merge_program)(per_shard_states)
+    program = jax.shard_map(
+        merge_program,
+        mesh=mesh,
+        in_specs=(shard_spec,),
+        out_specs=jax.tree_util.tree_map(
+            lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), padded
+        ),
+        check_vma=False,
+    )
+    merged = jax.jit(program)(padded)
+    # every device holds the identical full merge; take device 0's copy
+    return tuple(
+        jax.tree_util.tree_map(lambda x: x[0], tree) for tree in merged
+    )

@@ -120,6 +120,33 @@ class TestCollectiveMerge:
             )
 
 
+class TestCollectiveMergeNonPow2:
+    def test_three_device_mesh_gather_path(self):
+        """Non-power-of-two meshes take the all_gather + local-fold path and
+        must still fold every shard exactly once."""
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.runners.engine import ScanEngine
+
+        analyzers = [Size(), Mean("x")]
+        shard_states = []
+        for d in range(5):
+            data = Dataset.from_dict({"x": np.full(10 * (d + 1), float(d))})
+            states, _ = ScanEngine(analyzers).run(data)
+            shard_states.append(states)
+        stacked = tuple(
+            jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *[s[i] for s in shard_states]
+            )
+            for i in range(len(analyzers))
+        )
+        mesh3 = make_mesh(3)
+        merged = collective_merge_states(analyzers, mesh3, stacked)
+        assert int(np.asarray(merged[0].num_matches)) == 10 + 20 + 30 + 40 + 50
+        expected_mean = sum(10 * (d + 1) * d for d in range(5)) / 150
+        got = float(np.asarray(merged[1].total) / np.asarray(merged[1].count))
+        assert got == pytest.approx(expected_mean)
+
+
 class TestReviewRegressions:
     def test_merge_more_shards_than_devices(self, mesh):
         """8 persisted shard states on any mesh must fold ALL shards."""
