@@ -240,6 +240,94 @@ class Analyzer(abc.ABC, Generic[S, M]):
         raise NotImplementedError
 
 
+class HostBatchContext:
+    """Per-batch helper for the host ingest tier: caches predicate masks so
+    N analyzers sharing a `where` filter evaluate it once (the
+    `conditionalSelection` analog on the host side)."""
+
+    def __init__(self, batch, batch_index: int = 0):
+        self.batch = batch
+        self.batch_index = batch_index
+        self._pred_cache: Dict[str, np.ndarray] = {}
+        self._pred_columns = None
+
+    def pred_mask(self, predicate) -> np.ndarray:
+        key = str(predicate)
+        cached = self._pred_cache.get(key)
+        if cached is None:
+            from ..expr import evaluate_predicate
+            from ..runners.features import _predicate_columns
+
+            if self._pred_columns is None:
+                self._pred_columns = _predicate_columns(self.batch)
+            cached = evaluate_predicate(
+                predicate, self._pred_columns, len(self.batch.row_mask)
+            ) & self.batch.row_mask
+            self._pred_cache[key] = cached
+        return cached
+
+    def row_mask(self, analyzer) -> np.ndarray:
+        """batch row mask & the analyzer's where-filter."""
+        where = getattr(analyzer, "where", None)
+        if where is None:
+            return self.batch.row_mask
+        return self.pred_mask(where)
+
+    def column_mask(self, analyzer, column: str) -> np.ndarray:
+        return self.row_mask(analyzer) & self.batch.column(column).mask
+
+    def block_stats(self, analyzer, column: str) -> np.ndarray:
+        """[count, sum, min, max, m2] over the analyzer-masked column — ONE
+        native pass shared by Mean/Sum/Min/Max/StdDev on the same column
+        (the host-tier analog of their fused device updates)."""
+        where = getattr(analyzer, "where", None)
+        key = ("stats", column, None if where is None else str(where))
+        cached = self._pred_cache.get(key)
+        if cached is None:
+            col = self.batch.column(column)
+            mask = self.column_mask(analyzer, column)
+            vals = col.values
+            if not np.issubdtype(vals.dtype, np.number):
+                vals = col.numeric_f64()
+            from ..native import native_block_stats
+
+            if native_block_stats is not None:
+                cached = native_block_stats(vals, mask)
+            else:
+                v = vals[mask].astype(np.float64)
+                if v.size == 0:
+                    cached = np.array([0.0, 0.0, 0.0, 0.0, 0.0])
+                else:
+                    cached = np.array(
+                        [v.size, v.sum(), v.min(), v.max(),
+                         ((v - v.mean()) ** 2).sum()]
+                    )
+            self._pred_cache[key] = cached
+        return cached
+
+    def string_lengths(self, column: str) -> np.ndarray:
+        key = ("len", column)
+        cached = self._pred_cache.get(key)
+        if cached is None:
+            from ..runners.features import string_lengths
+
+            col = self.batch.column(column)
+            cached = string_lengths(col.values, col.mask)
+            self._pred_cache[key] = cached
+        return cached
+
+    def type_codes(self, column: str) -> np.ndarray:
+        key = ("type", column)
+        cached = self._pred_cache.get(key)
+        if cached is None:
+            from ..runners.features import classify_type_codes
+
+            col = self.batch.column(column)
+            cached = classify_type_codes(col.values, col.mask, col.kind)
+            self._pred_cache[key] = cached
+        return cached
+
+
 class ScanShareableAnalyzer(Analyzer[S, M]):
     """Analyzer whose state updates fuse into the shared single-pass scan."""
 
@@ -255,6 +343,24 @@ class ScanShareableAnalyzer(Analyzer[S, M]):
     def update(self, state: S, features: Dict[str, jnp.ndarray]) -> S:
         """Fold one batch into the state. Traced under jit; must be pure,
         fixed-shape jax ops only."""
+
+    #: whether `host_partial` is implemented (the engine streams raw columns
+    #: to the device when any requested analyzer lacks the host tier)
+    supports_host_partial: bool = False
+
+    def host_partial(self, ctx: "HostBatchContext") -> Any:
+        """Per-batch partial state computed host-side by the native ingest
+        tier (one C pass per block). Used when the accelerator feed link
+        cannot sustain raw column streaming: the device then folds the tiny
+        partials with `ingest_partial` — the same partial-aggregate-near-
+        the-data + algebraic-merge split Spark executes executor-side
+        (reference `AnalysisRunner.scala:303-318`, SURVEY.md §2.9)."""
+        raise NotImplementedError
+
+    def ingest_partial(self, state: S, partial: Any) -> S:
+        """Fold one host partial into the device state (traced under jit).
+        Default: the partial IS a state — semigroup merge."""
+        return self.merge(state, partial)
 
     def _row_mask(self, features: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         """Valid-row mask combined with this analyzer's where-filter

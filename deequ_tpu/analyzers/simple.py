@@ -71,6 +71,14 @@ def _masked_max(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(jnp.where(mask, values, -np.inf).astype(ACC_DTYPE))
 
 
+def _np_count(n) -> np.ndarray:
+    return np.asarray(int(n), dtype=COUNT_DTYPE)
+
+
+def _np_acc(x) -> np.ndarray:
+    return np.asarray(x, dtype=ACC_DTYPE)
+
+
 @dataclass(frozen=True)
 class Size(StandardScanShareableAnalyzer[NumMatches]):
     """Row count (reference `analyzers/Size.scala:23-48`)."""
@@ -94,6 +102,11 @@ class Size(StandardScanShareableAnalyzer[NumMatches]):
 
     def init_state(self) -> NumMatches:
         return NumMatches.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> NumMatches:
+        return NumMatches(_np_count(np.count_nonzero(ctx.row_mask(self))))
 
     def update(self, state: NumMatches, features: Dict[str, jnp.ndarray]) -> NumMatches:
         return NumMatches(state.num_matches + _count(self._row_mask(features)))
@@ -147,6 +160,16 @@ class Completeness(_RatioAnalyzer):
             specs.append(predicate_feature(self.where))
         return specs
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> NumMatchesAndCount:
+        rows = ctx.row_mask(self)
+        present = ctx.batch.column(self.column).mask
+        return NumMatchesAndCount(
+            _np_count(np.count_nonzero(rows & present)),
+            _np_count(np.count_nonzero(rows)),
+        )
+
     def update(self, state, features):
         rows = self._row_mask(features)
         present = features[mask_feature(self.column).key]
@@ -179,6 +202,16 @@ class Compliance(_RatioAnalyzer):
         if self.where is not None:
             specs.append(predicate_feature(self.where))
         return specs
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> NumMatchesAndCount:
+        rows = ctx.row_mask(self)
+        matches = ctx.pred_mask(self.predicate)
+        return NumMatchesAndCount(
+            _np_count(np.count_nonzero(rows & matches)),
+            _np_count(np.count_nonzero(rows)),
+        )
 
     def update(self, state, features):
         rows = self._row_mask(features)
@@ -237,6 +270,19 @@ class PatternMatch(_RatioAnalyzer):
             specs.append(predicate_feature(self.where))
         return specs
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> NumMatchesAndCount:
+        from ..runners.features import regex_matches
+
+        col = ctx.batch.column(self.column)
+        rows = ctx.row_mask(self)
+        matches = regex_matches(col.values, col.mask, self.pattern)
+        return NumMatchesAndCount(
+            _np_count(np.count_nonzero(rows & matches)),
+            _np_count(np.count_nonzero(rows)),
+        )
+
     def update(self, state, features):
         rows = self._row_mask(features)
         matches = features[regex_feature(self.column, self.pattern).key]
@@ -283,6 +329,12 @@ class Mean(_NumericColumnAnalyzer):
     def init_state(self) -> MeanState:
         return MeanState.init()
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> MeanState:
+        count, total, _mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        return MeanState(_np_acc(total), _np_count(count))
+
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
         return MeanState(state.total + _masked_sum(v, mask), state.count + _count(mask))
@@ -305,6 +357,12 @@ class Sum(_NumericColumnAnalyzer):
 
     def init_state(self) -> SumState:
         return SumState.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> SumState:
+        count, total, _mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        return SumState(_np_acc(total), _np_count(count))
 
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
@@ -329,6 +387,12 @@ class Minimum(_NumericColumnAnalyzer):
     def init_state(self) -> MinState:
         return MinState.init()
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> MinState:
+        count, _s, mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        return MinState(_np_acc(mn if count > 0 else np.inf), _np_count(count))
+
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
         return MinState(jnp.minimum(state.min_value, _masked_min(v, mask)), state.count + _count(mask))
@@ -351,6 +415,12 @@ class Maximum(_NumericColumnAnalyzer):
 
     def init_state(self) -> MaxState:
         return MaxState.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> MaxState:
+        count, _s, _mn, mx, _m2 = ctx.block_stats(self, self.column)
+        return MaxState(_np_acc(mx if count > 0 else -np.inf), _np_count(count))
 
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
@@ -411,6 +481,15 @@ class MinLength(_LengthAnalyzer):
     def init_state(self) -> MinState:
         return MinState.init()
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> MinState:
+        lengths = ctx.string_lengths(self.column)
+        mask = ctx.column_mask(self, self.column)
+        n = int(np.count_nonzero(mask))
+        mn = float(lengths[mask].min()) if n else np.inf
+        return MinState(_np_acc(mn), _np_count(n))
+
     def update(self, state, features):
         lengths, mask = self._lengths_and_mask(features)
         return MinState(
@@ -426,6 +505,15 @@ class MaxLength(_LengthAnalyzer):
 
     def init_state(self) -> MaxState:
         return MaxState.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> MaxState:
+        lengths = ctx.string_lengths(self.column)
+        mask = ctx.column_mask(self, self.column)
+        n = int(np.count_nonzero(mask))
+        mx = float(lengths[mask].max()) if n else -np.inf
+        return MaxState(_np_acc(mx), _np_count(n))
 
     def update(self, state, features):
         lengths, mask = self._lengths_and_mask(features)
@@ -443,6 +531,15 @@ class StandardDeviation(_NumericColumnAnalyzer):
 
     def init_state(self) -> StandardDeviationState:
         return StandardDeviationState.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> StandardDeviationState:
+        count, total, _mn, _mx, m2 = ctx.block_stats(self, self.column)
+        avg = total / count if count > 0 else 0.0
+        return StandardDeviationState(
+            _np_acc(count), _np_acc(avg), _np_acc(m2 if count > 0 else 0.0)
+        )
 
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
@@ -504,6 +601,34 @@ class Correlation(StandardScanShareableAnalyzer[CorrelationState]):
 
     def init_state(self) -> CorrelationState:
         return CorrelationState.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> CorrelationState:
+        from ..native import native_block_comoments
+
+        cx = ctx.batch.column(self.first_column)
+        cy = ctx.batch.column(self.second_column)
+        mask = ctx.row_mask(self) & cx.mask & cy.mask
+        vx = cx.values if np.issubdtype(cx.values.dtype, np.number) else cx.numeric_f64()
+        vy = cy.values if np.issubdtype(cy.values.dtype, np.number) else cy.numeric_f64()
+        if native_block_comoments is not None:
+            n, xs, ys, ck, xmk, ymk = native_block_comoments(vx, vy, mask)
+        else:
+            x, y = vx[mask].astype(np.float64), vy[mask].astype(np.float64)
+            n = float(x.size)
+            xs, ys = x.sum(), y.sum()
+            if n > 0:
+                dx, dy = x - x.mean(), y - y.mean()
+                ck, xmk, ymk = (dx * dy).sum(), (dx * dx).sum(), (dy * dy).sum()
+            else:
+                ck = xmk = ymk = 0.0
+        xa = xs / n if n > 0 else 0.0
+        ya = ys / n if n > 0 else 0.0
+        return CorrelationState(
+            _np_acc(n), _np_acc(xa), _np_acc(ya),
+            _np_acc(ck), _np_acc(xmk), _np_acc(ymk),
+        )
 
     def update(self, state, features):
         x = features[numeric_feature(self.first_column).key]
@@ -573,6 +698,14 @@ class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
 
     def init_state(self) -> DataTypeHistogram:
         return DataTypeHistogram.init()
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> DataTypeHistogram:
+        codes = ctx.type_codes(self.column)
+        mask = ctx.row_mask(self)
+        counts = np.bincount(codes[mask], minlength=5).astype(COUNT_DTYPE)
+        return DataTypeHistogram(counts)
 
     def update(self, state, features):
         codes = features[typeclass_feature(self.column).key]

@@ -89,11 +89,48 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
     def init_state(self) -> ApproxCountDistinctState:
         return ApproxCountDistinctState.init()
 
+    supports_host_partial = True
+
+    def host_partial(self, ctx) -> ApproxCountDistinctState:
+        from ..data import ColumnKind
+        from ..native import native_block_hll, native_block_hll_strings
+        from ..ops.hashing import DEFAULT_SEED
+
+        col = ctx.batch.column(self.column)
+        mask = ctx.column_mask(self, self.column)
+        if col.kind == ColumnKind.STRING and col.values.dtype == object:
+            if native_block_hll_strings is not None:
+                regs = native_block_hll_strings(col.values, mask, DEFAULT_SEED)
+                return ApproxCountDistinctState(regs.astype(np.int32))
+        elif native_block_hll is not None and (
+            col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN
+        ):
+            vals = col.values
+            if vals.dtype == np.bool_ or (
+                np.issubdtype(vals.dtype, np.integer) and vals.dtype != np.int64
+            ):
+                vals = vals.astype(np.int64)
+            if np.issubdtype(vals.dtype, np.number):
+                regs = native_block_hll(vals, mask, DEFAULT_SEED)
+                return ApproxCountDistinctState(regs.astype(np.int32))
+        # numpy fallback: hash + scatter-max
+        from ..ops.hashing import hash_column
+        from ..ops.hll import M, hll_features
+
+        pairs = hll_features(hash_column(col.values, col.mask, col.kind))
+        regs = np.zeros(M, dtype=np.int32)
+        np.maximum.at(regs, pairs[0][mask], pairs[1][mask])
+        return ApproxCountDistinctState(regs)
+
     def update(self, state, features):
         from ..ops.hll import M
 
-        pairs = features[hll_feature(self.column).key]
-        idx, pw = pairs[0], pairs[1]
+        packed = features[hll_feature(self.column).key]
+        # wire format: uint16 (idx << 6) | pw — 2 bytes/row on the host feed
+        # (see ops/hll.hll_pack_features); nulls arrive pre-packed as 0
+        p = packed.astype(jnp.int32)
+        idx = p >> 6
+        pw = p & 63
         mask = self._row_mask(features) & features[mask_feature(self.column).key]
         # masked-out rows contribute 0, which never wins a max against the
         # (non-negative) register values
@@ -165,6 +202,58 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
 
     def merge(self, a, b):
         return kll_merge(a, b)
+
+    supports_host_partial = True
+
+    def host_partial(self, ctx):
+        from ..config import ACC_DTYPE, COUNT_DTYPE
+        from ..native import native_block_kll_sample
+
+        col = ctx.batch.column(self.column)
+        mask = ctx.column_mask(self, self.column)
+        vals = col.values if np.issubdtype(col.values.dtype, np.number) else col.numeric_f64()
+        k = self._sketch_size()
+        if native_block_kll_sample is not None:
+            items, m, h, nv, mn, mx = native_block_kll_sample(
+                vals, mask, k, ctx.batch_index
+            )
+        else:
+            items, m, h, nv, mn, mx = _np_kll_sample(vals, mask, k, ctx.batch_index)
+        return (
+            items.astype(np.float64),
+            np.int32(m),
+            np.int32(h),
+            np.asarray(nv, dtype=COUNT_DTYPE),
+            np.asarray(mn, dtype=ACC_DTYPE),
+            np.asarray(mx, dtype=ACC_DTYPE),
+        )
+
+    def ingest_partial(self, state, partial):
+        from ..ops.kll import kll_ingest_sampled
+
+        items, m, h, nv, mn, mx = partial
+        return kll_ingest_sampled(state, items, m, h, nv, mn, mx)
+
+
+def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
+    """numpy fallback for native block_kll_sample (same sampler semantics)."""
+    v = np.asarray(values, dtype=np.float64)
+    ok = np.asarray(mask, dtype=bool) & ~np.isnan(v)
+    vv = v[ok]
+    nv = int(vv.size)
+    items = np.full(k, np.inf, dtype=np.float64)
+    if nv == 0:
+        return items, 0, 0, 0, np.inf, -np.inf
+    h = 0
+    stride = 1
+    while stride * k < nv:
+        stride <<= 1
+        h += 1
+    r = (np.uint32(tick) * np.uint32(2654435761)) >> np.uint32(7)
+    offset = int(r % np.uint32(stride))
+    picked = np.sort(vv[offset::stride])[:k]
+    items[: picked.size] = picked
+    return items, int(picked.size), h, nv, float(vv.min()), float(vv.max())
 
 
 @dataclass(frozen=True)

@@ -266,7 +266,9 @@ class Dataset:
         else:
             mask = np.ones(n, dtype=bool)
         if kind.is_numeric:
-            values = arr.to_numpy(zero_copy_only=False)
+            values = _numeric_buffer_view(arr, n)
+            if values is None:
+                values = arr.to_numpy(zero_copy_only=False)
         elif kind == ColumnKind.BOOLEAN:
             values = arr.to_numpy(zero_copy_only=False)
             if values.dtype == object:
@@ -301,6 +303,40 @@ class Dataset:
             yield Batch(cols, row_mask, m)
             if n == 0:
                 break
+
+
+#: fixed-width arrow types whose values buffer is a plain numpy dtype
+_ZERO_COPY_DTYPES = None
+
+
+def _zero_copy_dtype(t: "pa.DataType"):
+    global _ZERO_COPY_DTYPES
+    if _ZERO_COPY_DTYPES is None:
+        _ZERO_COPY_DTYPES = {
+            pa.int8(): np.int8, pa.int16(): np.int16,
+            pa.int32(): np.int32, pa.int64(): np.int64,
+            pa.uint8(): np.uint8, pa.uint16(): np.uint16,
+            pa.uint32(): np.uint32, pa.uint64(): np.uint64,
+            pa.float32(): np.float32, pa.float64(): np.float64,
+        }
+    return _ZERO_COPY_DTYPES.get(t)
+
+
+def _numeric_buffer_view(arr: "pa.Array", n: int) -> Optional[np.ndarray]:
+    """Zero-copy numpy view of a primitive arrow array's values buffer.
+
+    Null slots carry whatever bytes Arrow left there (NOT NaN) — callers
+    must treat masked-out positions as garbage. This is the contract the
+    device feature feed relies on: every analyzer update masks before use,
+    so the scan path makes no host-side copy of numeric columns at all."""
+    dtype = _zero_copy_dtype(arr.type)
+    if dtype is None:
+        return None
+    buf = arr.buffers()[1]
+    if buf is None:
+        return None
+    view = np.frombuffer(buf, dtype=dtype, count=arr.offset + n)
+    return view[arr.offset:]
 
 
 def _pad_column(col: Column, size: int) -> Column:

@@ -26,9 +26,29 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 
+_u16p = ctypes.POINTER(ctypes.c_uint16)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
 _lib.xxhash64_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u64p]
 _lib.classify_types_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, _i32p]
 _lib.string_lengths_batch.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, _i32p]
+_lib.hll_pack_f64.argtypes = [_f64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u16p]
+_lib.hll_pack_i64.argtypes = [_i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u16p]
+_lib.hll_pack_strings.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u16p]
+_f32p = ctypes.POINTER(ctypes.c_float)
+for _name, _vp in (
+    ("block_stats_f64", _f64p), ("block_stats_f32", _f32p),
+    ("block_stats_i64", _i64p), ("block_stats_i32", _i32p),
+):
+    getattr(_lib, _name).argtypes = [_vp, _u8p, ctypes.c_int64, _f64p]
+_lib.block_comoments_f64.argtypes = [_f64p, _f64p, _u8p, ctypes.c_int64, _f64p]
+_lib.block_hll_f64.argtypes = [_f64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u8p]
+_lib.block_hll_i64.argtypes = [_i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u8p]
+_lib.block_hll_strings.argtypes = [_u8p, _i64p, _u8p, ctypes.c_int64, ctypes.c_uint64, _u8p]
+_lib.block_kll_sample_f64.argtypes = [
+    _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
+    _f64p, _i64p, _f64p,
+]
 
 
 def _arrow_layout(values: np.ndarray):
@@ -88,3 +108,124 @@ def native_string_lengths(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
         _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p), n, _ptr(out, _i32p)
     )
     return out
+
+
+def native_hll_pack_numeric(values: np.ndarray, mask: np.ndarray, seed: int) -> np.ndarray:
+    """uint16 (idx<<6)|pw HLL feature per row from a numeric array; nulls -> 0.
+    Doubles hash as IEEE754 bits (-0.0 normalized), integrals/booleans as
+    int64 — matching Spark's per-type layout (see ops/hashing.hash_column)."""
+    n = len(values)
+    out = np.empty(n, dtype=np.uint16)
+    valid = None if mask is None else np.ascontiguousarray(mask, dtype=np.uint8)
+    vp = _ptr(valid, _u8p) if valid is not None else None
+    if np.issubdtype(values.dtype, np.floating):
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        _lib.hll_pack_f64(_ptr(vals, _f64p), vp, n, ctypes.c_uint64(seed), _ptr(out, _u16p))
+    else:
+        vals = np.ascontiguousarray(values, dtype=np.int64)
+        _lib.hll_pack_i64(_ptr(vals, _i64p), vp, n, ctypes.c_uint64(seed), _ptr(out, _u16p))
+    return out
+
+
+def native_hll_pack_strings(values: np.ndarray, mask: np.ndarray, seed: int) -> np.ndarray:
+    data, offsets, valid = _arrow_layout(values)
+    if mask is not None:
+        valid = valid & np.asarray(mask, dtype=np.uint8)
+    n = len(values)
+    out = np.empty(n, dtype=np.uint16)
+    _lib.hll_pack_strings(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p),
+        n, ctypes.c_uint64(seed), _ptr(out, _u16p),
+    )
+    return out
+
+
+# -- block-partial reduction kernels (ingest tier) ---------------------------
+
+_BLOCK_STATS = {
+    np.dtype(np.float64): ("block_stats_f64", _f64p),
+    np.dtype(np.float32): ("block_stats_f32", _f32p),
+    np.dtype(np.int64): ("block_stats_i64", _i64p),
+    np.dtype(np.int32): ("block_stats_i32", _i32p),
+}
+
+
+def _mask_u8(mask):
+    if mask is None:
+        return None, None
+    m = np.ascontiguousarray(mask, dtype=np.uint8)
+    return m, _ptr(m, _u8p)
+
+
+def native_block_stats(values: np.ndarray, mask) -> np.ndarray:
+    """One C pass -> [count, sum, min, max, m2] over the masked block."""
+    entry = _BLOCK_STATS.get(values.dtype)
+    if entry is None:
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        entry = _BLOCK_STATS[values.dtype]
+    else:
+        values = np.ascontiguousarray(values)
+    name, vp = entry
+    out = np.empty(5, dtype=np.float64)
+    _m, mp = _mask_u8(mask)
+    getattr(_lib, name)(_ptr(values, vp), mp, len(values), _ptr(out, _f64p))
+    return out
+
+
+def native_block_comoments(x: np.ndarray, y: np.ndarray, mask) -> np.ndarray:
+    """[n, xsum, ysum, ck, xmk, ymk] co-moments over the jointly-masked block."""
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.ascontiguousarray(y, dtype=np.float64)
+    out = np.empty(6, dtype=np.float64)
+    _m, mp = _mask_u8(mask)
+    _lib.block_comoments_f64(_ptr(x, _f64p), _ptr(y, _f64p), mp, len(x), _ptr(out, _f64p))
+    return out
+
+
+def native_block_hll(values: np.ndarray, mask, seed: int,
+                     regs: np.ndarray | None = None) -> np.ndarray:
+    """Update (or create) a uint8[512] HLL register block from numeric values."""
+    if regs is None:
+        regs = np.zeros(512, dtype=np.uint8)
+    _m, mp = _mask_u8(mask)
+    if np.issubdtype(values.dtype, np.floating):
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        _lib.block_hll_f64(_ptr(vals, _f64p), mp, len(vals), ctypes.c_uint64(seed), _ptr(regs, _u8p))
+    else:
+        vals = np.ascontiguousarray(values, dtype=np.int64)
+        _lib.block_hll_i64(_ptr(vals, _i64p), mp, len(vals), ctypes.c_uint64(seed), _ptr(regs, _u8p))
+    return regs
+
+
+def native_block_hll_strings(values: np.ndarray, mask, seed: int,
+                             regs: np.ndarray | None = None) -> np.ndarray:
+    if regs is None:
+        regs = np.zeros(512, dtype=np.uint8)
+    data, offsets, valid = _arrow_layout(values)
+    if mask is not None:
+        valid = valid & np.asarray(mask, dtype=np.uint8)
+    _lib.block_hll_strings(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p),
+        len(values), ctypes.c_uint64(seed), _ptr(regs, _u8p),
+    )
+    return regs
+
+
+def native_block_kll_sample(values: np.ndarray, mask, k: int, tick: int):
+    """(items f64[k] sorted asc with +inf padding, m, h, nv, min, max)."""
+    vals = np.ascontiguousarray(values, dtype=np.float64)
+    items = np.full(k, np.inf, dtype=np.float64)
+    meta = np.zeros(3, dtype=np.int64)
+    minmax = np.zeros(2, dtype=np.float64)
+    _m, mp = _mask_u8(mask)
+    _lib.block_kll_sample_f64(
+        _ptr(vals, _f64p), mp, len(vals), ctypes.c_int32(k),
+        ctypes.c_uint32(tick & 0xFFFFFFFF),
+        _ptr(items, _f64p), _ptr(meta, _i64p), _ptr(minmax, _f64p),
+    )
+    m, h, nv = int(meta[0]), int(meta[1]), int(meta[2])
+    items[m:] = np.inf
+    if nv == 0:
+        # identity element: no items, min/max at the fold identities
+        return items, 0, 0, 0, np.inf, -np.inf
+    return items, m, h, nv, float(minmax[0]), float(minmax[1])

@@ -12,6 +12,7 @@
 // Build: python -m deequ_tpu.native.build  (g++ -O3 -shared -fPIC)
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 extern "C" {
@@ -102,6 +103,80 @@ void xxhash64_batch(const uint8_t* data, const int64_t* offsets,
 }
 
 // ---------------------------------------------------------------------------
+// HLL ingest: hash value -> (register index, leading-zero count), packed as
+// uint16 = (idx << 6) | pw. One pass per column, so the device feed is 2
+// bytes/row instead of 8 (mirrors the per-row math of the reference
+// `StatefulHyperloglogPlus.update`, `StatefulHyperloglogPlus.scala:93-114`:
+// idx = top P bits, pw = clz((hash << P) | 1 << (P-1)) + 1, P = 9).
+// Nulls pack as 0 (idx 0, pw 0), which never wins a register max.
+// ---------------------------------------------------------------------------
+
+static const int HLL_P = 9;
+
+static inline uint16_t hll_pack_hash(uint64_t h) {
+  uint32_t idx = (uint32_t)(h >> (64 - HLL_P));
+  uint64_t w = (h << HLL_P) | (1ULL << (HLL_P - 1));
+  // w always has a bit set (the padding bit), so clzll is defined
+  uint32_t pw = (uint32_t)__builtin_clzll(w) + 1;
+  return (uint16_t)((idx << 6) | pw);
+}
+
+static inline uint64_t xxh64_fixed8(uint64_t value, uint64_t seed) {
+  // xxh64 specialized to an 8-byte input (Spark hashes fixed-width values
+  // as one little-endian long)
+  uint64_t h = seed + P5 + 8;
+  uint64_t k = rotl64(value * P2, 31) * P1;
+  h ^= k;
+  h = rotl64(h, 27) * P1 + P4;
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// doubles: IEEE754 bits with -0.0 normalized to 0.0 (Spark semantics)
+void hll_pack_f64(const double* vals, const uint8_t* valid, int64_t n,
+                  uint64_t seed, uint16_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    double d = vals[i] == 0.0 ? 0.0 : vals[i];  // collapses -0.0
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    out[i] = hll_pack_hash(xxh64_fixed8(bits, seed));
+  }
+}
+
+void hll_pack_i64(const int64_t* vals, const uint8_t* valid, int64_t n,
+                  uint64_t seed, uint16_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    out[i] = hll_pack_hash(xxh64_fixed8((uint64_t)vals[i], seed));
+  }
+}
+
+// strings in arrow large-string layout
+void hll_pack_strings(const uint8_t* data, const int64_t* offsets,
+                      const uint8_t* valid, int64_t n, uint64_t seed,
+                      uint16_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    out[i] = hll_pack_hash(
+        xxh64(data + offsets[i], offsets[i + 1] - offsets[i], seed));
+  }
+}
+
+// ---------------------------------------------------------------------------
 // type classification (reference regexes,
 // `analyzers/catalyst/StatefulDataType.scala:36-38`):
 //   FRACTIONAL: ^(-|\+)? ?\d*\.\d*$
@@ -173,6 +248,176 @@ void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
     }
     out[i] = count;
   }
+}
+
+// ---------------------------------------------------------------------------
+// Block-partial reduction kernels (the ingest tier).
+//
+// When the accelerator feed link cannot sustain raw column streaming (the
+// engine probes this), per-batch partial states are computed here — one
+// C-speed pass over the block — and the device folds the tiny states with
+// the same semigroup `merge` algebra it uses across shards (SURVEY.md §2.9:
+// partial aggregation near the data + algebraic merge IS the reference's
+// execution model; Spark's partial-agg runs executor-side for the same
+// reason). Two-pass moments match the batch formulas of the device update
+// (`analyzers/simple.py` StandardDeviation/Correlation.update).
+// ---------------------------------------------------------------------------
+
+#define BLOCK_STATS_IMPL(NAME, T)                                            \
+  void NAME(const T* v, const uint8_t* m, int64_t n, double* out) {          \
+    /* out: [count, sum, min, max, m2] */                                    \
+    double sum = 0.0, mn = 0.0, mx = 0.0;                                    \
+    int64_t count = 0;                                                       \
+    for (int64_t i = 0; i < n; ++i) {                                        \
+      if (m != nullptr && !m[i]) continue;                                   \
+      double x = (double)v[i];                                               \
+      if (count == 0) { mn = x; mx = x; }                                    \
+      else {                                                                 \
+        if (x < mn) mn = x;                                                  \
+        if (x > mx) mx = x;                                                  \
+      }                                                                      \
+      sum += x;                                                              \
+      ++count;                                                               \
+    }                                                                        \
+    double m2 = 0.0;                                                         \
+    if (count > 0) {                                                         \
+      double mean = sum / (double)count;                                     \
+      for (int64_t i = 0; i < n; ++i) {                                      \
+        if (m != nullptr && !m[i]) continue;                                 \
+        double d = (double)v[i] - mean;                                      \
+        m2 += d * d;                                                         \
+      }                                                                      \
+    }                                                                        \
+    out[0] = (double)count;                                                  \
+    out[1] = sum;                                                            \
+    out[2] = mn;                                                             \
+    out[3] = mx;                                                             \
+    out[4] = m2;                                                             \
+  }
+
+BLOCK_STATS_IMPL(block_stats_f64, double)
+BLOCK_STATS_IMPL(block_stats_f32, float)
+BLOCK_STATS_IMPL(block_stats_i64, int64_t)
+BLOCK_STATS_IMPL(block_stats_i32, int32_t)
+
+// Pearson co-moments for Correlation: out = [n, xsum, ysum, ck, xmk, ymk]
+void block_comoments_f64(const double* x, const double* y, const uint8_t* m,
+                         int64_t n, double* out) {
+  double xs = 0.0, ys = 0.0;
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (m != nullptr && !m[i]) continue;
+    xs += x[i];
+    ys += y[i];
+    ++count;
+  }
+  double ck = 0.0, xmk = 0.0, ymk = 0.0;
+  if (count > 0) {
+    double xa = xs / (double)count, ya = ys / (double)count;
+    for (int64_t i = 0; i < n; ++i) {
+      if (m != nullptr && !m[i]) continue;
+      double dx = x[i] - xa, dy = y[i] - ya;
+      ck += dx * dy;
+      xmk += dx * dx;
+      ymk += dy * dy;
+    }
+  }
+  out[0] = (double)count;
+  out[1] = xs;
+  out[2] = ys;
+  out[3] = ck;
+  out[4] = xmk;
+  out[5] = ymk;
+}
+
+// HLL register update in place: regs[512] must be zero- or prior-initialized
+#define BLOCK_HLL_IMPL(NAME, T, TOBITS)                                      \
+  void NAME(const T* v, const uint8_t* m, int64_t n, uint64_t seed,          \
+            uint8_t* regs) {                                                 \
+    for (int64_t i = 0; i < n; ++i) {                                        \
+      if (m != nullptr && !m[i]) continue;                                   \
+      uint64_t bits = TOBITS(v[i]);                                          \
+      uint64_t h = xxh64_fixed8(bits, seed);                                 \
+      uint32_t idx = (uint32_t)(h >> (64 - HLL_P));                          \
+      uint64_t w = (h << HLL_P) | (1ULL << (HLL_P - 1));                     \
+      uint8_t pw = (uint8_t)(__builtin_clzll(w) + 1);                        \
+      if (pw > regs[idx]) regs[idx] = pw;                                    \
+    }                                                                        \
+  }
+
+static inline uint64_t bits_of_double(double d) {
+  double z = d == 0.0 ? 0.0 : d;  // collapse -0.0 (Spark semantics)
+  uint64_t b;
+  std::memcpy(&b, &z, 8);
+  return b;
+}
+static inline uint64_t bits_of_i64(int64_t v) { return (uint64_t)v; }
+
+BLOCK_HLL_IMPL(block_hll_f64, double, bits_of_double)
+BLOCK_HLL_IMPL(block_hll_i64, int64_t, bits_of_i64)
+
+void block_hll_strings(const uint8_t* data, const int64_t* offsets,
+                       const uint8_t* valid, int64_t n, uint64_t seed,
+                       uint8_t* regs) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) continue;
+    uint64_t h = xxh64(data + offsets[i], offsets[i + 1] - offsets[i], seed);
+    uint32_t idx = (uint32_t)(h >> (64 - HLL_P));
+    uint64_t w = (h << HLL_P) | (1ULL << (HLL_P - 1));
+    uint8_t pw = (uint8_t)(__builtin_clzll(w) + 1);
+    if (pw > regs[idx]) regs[idx] = pw;
+  }
+}
+
+// KLL block pre-sample: take <= k valid values at stride 2^h (h minimal so
+// the sample fits), sort them, report (m, h, min, max, count). Stride
+// sampling over the unsorted block + per-call offset rotation is the
+// classical KLL bottom-sampler (items enter level h with weight 2^h); the
+// device-side kll_update uses sorted-stride order statistics instead —
+// both satisfy the KLL rank-error bound, and a run uses exactly one path.
+static int cmp_f64(const void* a, const void* b) {
+  double x = *(const double*)a, y = *(const double*)b;
+  return (x > y) - (x < y);
+}
+
+void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
+                          int32_t k, uint32_t tick, double* items,
+                          int64_t* out_meta, double* out_minmax) {
+  // pass 1: count valid (NaN excluded, like the device path)
+  int64_t nv = 0;
+  double mn = 0.0, mx = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (m != nullptr && !m[i]) continue;
+    double x = v[i];
+    if (x != x) continue;  // NaN
+    if (nv == 0) { mn = x; mx = x; }
+    else {
+      if (x < mn) mn = x;
+      if (x > mx) mx = x;
+    }
+    ++nv;
+  }
+  int64_t h = 0;
+  int64_t stride = 1;
+  while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
+  uint32_t r = (tick * 2654435761u) >> 7;
+  int64_t offset = (int64_t)(r % (uint32_t)stride);
+  int64_t taken = 0, seen = 0;
+  for (int64_t i = 0; i < n && taken < k; ++i) {
+    if (m != nullptr && !m[i]) continue;
+    double x = v[i];
+    if (x != x) continue;
+    if ((seen - offset) >= 0 && (seen - offset) % stride == 0) {
+      items[taken++] = x;
+    }
+    ++seen;
+  }
+  qsort(items, (size_t)taken, sizeof(double), cmp_f64);
+  out_meta[0] = taken;  // m
+  out_meta[1] = h;
+  out_meta[2] = nv;     // exact valid count
+  out_minmax[0] = mn;
+  out_minmax[1] = mx;
 }
 
 }  // extern "C"

@@ -18,6 +18,8 @@ parity (reference `StatefulHyperloglogPlus.scala:170-186`).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 #: HLL++ precision: relativeSD = 0.05 => p = ceil(2*log2(1.106/0.05)) = 9
@@ -159,6 +161,19 @@ def hll_features(hashes: np.ndarray) -> np.ndarray:
     w = (h << np.uint64(P)) | W_PADDING
     pw = _clz64(w) + 1
     return np.stack([idx, pw.astype(np.int32)])
+
+
+def hll_pack_features(hashes: np.ndarray, mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """uint16 (idx << 6) | pw per hash — the wire format of the HLL feature
+    (2 bytes/row instead of 8; pw <= 57 fits 6 bits, idx < 512 fits 10).
+    Masked rows pack as 0, which never wins a register max. numpy fallback
+    for the native C++ single-pass kernels (native/lib.py hll_pack_*)."""
+    pairs = hll_features(hashes)
+    packed = ((pairs[0].astype(np.uint16) << np.uint16(6))
+              | pairs[1].astype(np.uint16))
+    if mask is not None:
+        packed = np.where(mask, packed, np.uint16(0))
+    return packed
 
 
 def estimate_cardinality(registers: np.ndarray) -> float:

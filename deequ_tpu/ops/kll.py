@@ -196,6 +196,48 @@ def kll_update(state: KLLSketchState, values: jnp.ndarray, valid: jnp.ndarray) -
     )
 
 
+def kll_ingest_sampled(
+    state: KLLSketchState,
+    samples: jnp.ndarray,
+    m: jnp.ndarray,
+    h: jnp.ndarray,
+    nv: jnp.ndarray,
+    g_min: jnp.ndarray,
+    g_max: jnp.ndarray,
+) -> KLLSketchState:
+    """Fold a host-side pre-sampled block into the sketch: ``samples`` is a
+    sorted, +inf-padded (k,) vector of ``m`` items carrying weight ``2^h``
+    each, covering ``nv`` underlying values with the given block min/max
+    (the native ingest tier's `block_kll_sample` output — the bottom-sampler
+    form of kll_update's batch pre-collapse). Pure jax; runs inside the
+    jit'd partial-fold program."""
+    k = state.sketch_size
+    # clamp like kll_update: legitimate huge/-inf values saturate to the
+    # finite ITEM range (a -inf must stay minimum-side). Padding beyond the
+    # first m slots never enters the sketch (_append_level writes m items),
+    # so the +inf padding needs no special casing.
+    finfo_max = jnp.asarray(jnp.finfo(ITEM_DTYPE).max, dtype=jnp.float64)
+    sv = jnp.clip(
+        jnp.asarray(samples, dtype=jnp.float64), -finfo_max, finfo_max
+    ).astype(ITEM_DTYPE)
+
+    items, sizes = _append_level(
+        state.items, state.sizes, jnp.asarray(h, dtype=jnp.int32), sv,
+        jnp.asarray(m, dtype=jnp.int32),
+    )
+    items, sizes, parity = _compact_cascade(items, sizes, state.parity, k)
+    return KLLSketchState(
+        items=items,
+        sizes=sizes,
+        parity=parity,
+        ticks=state.ticks + 1,
+        count=state.count + jnp.asarray(nv, dtype=COUNT_DTYPE),
+        g_min=jnp.minimum(state.g_min, jnp.asarray(g_min, dtype=ACC_DTYPE)),
+        g_max=jnp.maximum(state.g_max, jnp.asarray(g_max, dtype=ACC_DTYPE)),
+        sketch_size=k,
+    )
+
+
 def kll_merge(a: KLLSketchState, b: KLLSketchState) -> KLLSketchState:
     """Semigroup sum: concatenate per-level buffers and re-compact
     (reference `QuantileNonSample.merge`, `analyzers/QuantileNonSample.scala:

@@ -56,6 +56,7 @@ class AnalysisRunner:
         batch_size: Optional[int] = None,
         monitor: Optional[RunMonitor] = None,
         sharding: Optional[Any] = None,
+        placement: Optional[str] = None,
     ) -> AnalyzerContext:
         if len(analyzers) == 0:
             return AnalyzerContext.empty()
@@ -126,7 +127,7 @@ class AnalysisRunner:
         ]
 
         # one shared pass over the data
-        engine = ScanEngine(scanning, monitor=monitor, sharding=sharding)
+        engine = ScanEngine(scanning, monitor=monitor, sharding=sharding, placement=placement)
         grouping_sets: Dict[Tuple[str, ...], List[GroupingAnalyzer]] = {}
         for g in grouping:
             grouping_sets.setdefault(tuple(g.grouping_columns()), []).append(g)

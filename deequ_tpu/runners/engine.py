@@ -14,10 +14,12 @@ observables so tests can assert scan-sharing invariants, not just values.
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..analyzers.base import ScanShareableAnalyzer
@@ -69,18 +71,156 @@ def _fused_program(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
     return program
 
 
+@jax.jit
+def _pack_leaves_f64(leaves):
+    """Concatenate every state leaf into ONE f64 device buffer. Fetching a
+    state pytree leaf-by-leaf costs a full device round-trip per buffer,
+    which on remote-tunnel devices (~100ms each) dominates the entire scan;
+    one packed fetch costs a single round trip regardless of battery size.
+    f64 represents every state dtype in use exactly (f32/f16 subsets; bool /
+    (u)int8/16/32 exactly; int64 counters exactly up to 2^53 — counters are
+    row counts, far below that). 64-bit *bitcasts* would be bit-perfect but
+    the TPU x64-emulation rewriter does not implement them."""
+    return jnp.concatenate(
+        [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
+    )
+
+
+@jax.jit
+def _pack_leaves_u8(leaves):
+    """32-bit-mode packing: bitcast each (<=32-bit) leaf to raw bytes —
+    bit-exact, and int32 values above f32's 2^24 integer range survive."""
+    parts = []
+    for leaf in leaves:
+        if leaf.dtype == jnp.bool_:
+            leaf = leaf.astype(jnp.uint8)
+        parts.append(jnp.ravel(jax.lax.bitcast_convert_type(leaf, jnp.uint8)))
+    return jnp.concatenate(parts)
+
+
+def _empty_batch_like(data: Dataset, columns):
+    """A 0-valid-row batch with the dataset's schema (identity partials)."""
+    names = list(columns) if columns is not None else data.schema.names
+    empty = data.arrow.slice(0, 0)
+    for b in Dataset(empty).batches(1, columns=names):
+        return b
+    raise AssertionError("batches() always yields at least one batch")
+
+
+def _fetch_states_packed(states: Tuple) -> List[Any]:
+    """Device states -> host numpy pytrees via one packed D2H transfer."""
+    leaves, treedef = jax.tree_util.tree_flatten(states)
+    if not leaves:
+        return list(states)
+    leaves = [jnp.asarray(l) for l in leaves]
+    x64 = jax.config.jax_enable_x64
+    out_leaves = []
+    if x64:
+        flat = np.asarray(_pack_leaves_f64(leaves))
+        offset = 0
+        for leaf in leaves:
+            part = flat[offset:offset + leaf.size]
+            out_leaves.append(
+                part.reshape(leaf.shape).astype(np.dtype(leaf.dtype.name))
+            )
+            offset += leaf.size
+    else:
+        raw = np.asarray(_pack_leaves_u8(leaves)).tobytes()
+        offset = 0
+        for leaf in leaves:
+            dtype = np.dtype(leaf.dtype.name)
+            host = np.frombuffer(raw, dtype=dtype, count=leaf.size, offset=offset)
+            out_leaves.append(host.reshape(leaf.shape).copy())
+            offset += leaf.size * dtype.itemsize
+    return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
+
+
+#: cached result of the device-feed bandwidth probe (MB/s), per process
+_FEED_BANDWIDTH_MBPS: Optional[float] = None
+
+#: feed bandwidth below which raw column streaming to the device loses to
+#: host-side partial aggregation (a TPU-VM PCIe/DMA link runs at GB/s; a
+#: remote tunnel runs at tens of MB/s)
+_FEED_BANDWIDTH_THRESHOLD_MBPS = 500.0
+
+
+def probe_feed_bandwidth() -> float:
+    """Measured round-trip bandwidth (MB/s) of the default-device feed link,
+    cached per process. A put+get round trip forces a REAL transfer — put
+    alone can report completion before bytes move on relayed transports."""
+    global _FEED_BANDWIDTH_MBPS
+    if _FEED_BANDWIDTH_MBPS is None:
+        arr = np.zeros(1 << 19, dtype=np.float64)  # 4 MB
+        import time
+
+        t0 = time.perf_counter()
+        d = jax.device_put(arr)
+        np.asarray(d)
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        _FEED_BANDWIDTH_MBPS = 2 * arr.nbytes / elapsed / 1e6
+    return _FEED_BANDWIDTH_MBPS
+
+
+_INGEST_CACHE: Dict[Tuple, Any] = {}
+
+#: batches folded per ingest-program call; fixed so the program shape (and
+#: therefore the compile) is independent of the run's batch count
+_INGEST_CHUNK = 32
+
+
+def _ingest_program(analyzers: Tuple[ScanShareableAnalyzer, ...]):
+    """jit'd fold of stacked host partials into device states via lax.scan —
+    the device-side half of the host ingest tier (the merge tree the TPU
+    owns; batch count appears only as the scan length)."""
+    cached = _INGEST_CACHE.get(analyzers)
+    if cached is not None:
+        return cached
+
+    def body(states, partial_slice):
+        new = tuple(
+            a.ingest_partial(s, p)
+            for a, s, p in zip(analyzers, states, partial_slice)
+        )
+        return new, None
+
+    def fold(states, stacked):
+        out, _ = jax.lax.scan(body, states, stacked)
+        return out
+
+    program = jax.jit(fold, donate_argnums=0)
+    _INGEST_CACHE[analyzers] = program
+    return program
+
+
 class ScanEngine:
-    """One shared pass: device-fused scan analyzers + host accumulators."""
+    """One shared pass: device-fused scan analyzers + host accumulators.
+
+    ``placement`` decides where the per-row work happens:
+
+    - ``"device"``: stream raw column batches to the accelerator; the fused
+      XLA program does everything (the default on TPU-VM-class feed links).
+    - ``"host"``: the native C ingest tier computes per-batch partial states
+      next to the data and the device folds the tiny partials — the same
+      partial-aggregate/merge split Spark runs executor-side (reference
+      `AnalysisRunner.scala:303-318`). Chosen when raw streaming would be
+      feed-bandwidth-bound.
+    - ``"auto"`` (default, or env DEEQU_TPU_PLACEMENT): probe the feed link
+      once per process and pick.
+    """
 
     def __init__(
         self,
         scan_analyzers: Sequence[ScanShareableAnalyzer],
         monitor: Optional[RunMonitor] = None,
         sharding: Optional[Any] = None,
+        placement: Optional[str] = None,
     ):
+        import os
+
         self.scan_analyzers = list(scan_analyzers)
         self.monitor = monitor or RunMonitor()
         self.mesh = sharding  # a jax.sharding.Mesh -> row-sharded GSPMD scan
+        self.placement = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
         self.builder = FeatureBuilder(
             [s for a in self.scan_analyzers for s in a.feature_specs()]
         )
@@ -91,8 +231,35 @@ class ScanEngine:
         else:
             self._update = _fused_program(tuple(analyzers), self.mesh)
 
+    def _resolve_placement(self) -> str:
+        if self.mesh is not None or not self.scan_analyzers:
+            return "device"  # sharded scans stream (partials are host-local)
+        if not all(a.supports_host_partial for a in self.scan_analyzers):
+            return "device"
+        if self.placement == "host":
+            return "host"
+        if self.placement == "auto":
+            if probe_feed_bandwidth() < _FEED_BANDWIDTH_THRESHOLD_MBPS:
+                return "host"
+        return "device"
+
     def required_columns(self) -> List[str]:
         return self.builder.required_columns
+
+    def _prepare(self, batch):
+        """Host side of one batch: feature build + device placement. Runs on
+        the prefetch thread so it overlaps the previous batch's device work
+        (numpy / pyarrow / the native C++ kernels all release the GIL)."""
+        features = self.builder.build(batch)
+        if self.mesh is not None:
+            from ..parallel import shard_features
+
+            features = shard_features(
+                features, self.mesh, batch_rows=len(batch.row_mask)
+            )
+        else:
+            features = jax.device_put(features)
+        return features
 
     def run(
         self,
@@ -115,29 +282,90 @@ class ScanEngine:
         update_fns = host_update_fns or {}
         if self._update is None and not host_states:
             return [], {}
+        if self._update is not None and self._resolve_placement() == "host":
+            return self._run_host_tier(
+                data, bs, host_states, update_fns, columns, states
+            )
         cache_size_fn = getattr(self._update, "_cache_size", None)
-        for batch in data.batches(bs, columns=columns):
-            monitor.batches += 1
-            if self._update is not None:
-                features = self.builder.build(batch)
-                if self.mesh is not None:
-                    from ..parallel import shard_features
 
-                    features = shard_features(
-                        features, self.mesh, batch_rows=len(batch.row_mask)
-                    )
-                states = self._update(states, features)
-                monitor.device_updates += 1
-            for key, fn in update_fns.items():
-                host_states[key] = fn(host_states[key], batch)
+        # pipelined pass: a single prefetch thread pulls batch i+1 and builds
+        # its features while the (async-dispatched) device program chews on
+        # batch i — the analog of Spark overlapping scan IO with aggregation
+        batches = data.batches(bs, columns=columns)
+
+        def produce():
+            try:
+                batch = next(batches)
+            except StopIteration:
+                return None
+            features = self._prepare(batch) if self._update is not None else None
+            return batch, features
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pending = pool.submit(produce)
+            while True:
+                item = pending.result()
+                if item is None:
+                    break
+                pending = pool.submit(produce)
+                batch, features = item
+                monitor.batches += 1
+                if features is not None:
+                    states = self._update(states, features)
+                    monitor.device_updates += 1
+                for key, fn in update_fns.items():
+                    host_states[key] = fn(host_states[key], batch)
         if cache_size_fn is not None:
             try:
                 monitor.jit_compiles = max(monitor.jit_compiles, cache_size_fn())
             except Exception:  # noqa: BLE001
                 pass
-        # bring device states to host numpy for merging/persistence/finalize;
-        # device_get batches the copies (one async copy per leaf, then one
-        # wait) — a per-leaf np.asarray would pay a full device round-trip
-        # per scalar, which dominates everything on remote-tunnel devices
-        host_side = list(jax.device_get(states))
+        host_side = _fetch_states_packed(states)
+        return host_side, host_states
+
+    def _run_host_tier(
+        self, data, bs, host_states, update_fns, columns, states
+    ) -> Tuple[List[Any], Dict[Any, Any]]:
+        """Host ingest tier: per-batch partial states next to the data, then
+        ONE device fold of the stacked partials (+ one packed state fetch) —
+        total device traffic is O(state size), independent of row count."""
+        from ..analyzers.base import HostBatchContext
+
+        monitor = self.monitor
+        analyzers = tuple(self.scan_analyzers)
+        partials: List[Tuple] = []
+        for index, batch in enumerate(
+            data.batches(bs, columns=columns, pad_to_batch_size=False)
+        ):
+            monitor.batches += 1
+            ctx = HostBatchContext(batch, batch_index=index)
+            partials.append(tuple(a.host_partial(ctx) for a in analyzers))
+            for key, fn in update_fns.items():
+                host_states[key] = fn(host_states[key], batch)
+
+        # fold in fixed-size chunks (padded with identity partials) so ONE
+        # compiled scan-fold program serves every run regardless of batch
+        # count — no recompile treadmill, warmups always hit
+        n = len(partials)
+        if n:
+            chunk = _INGEST_CHUNK
+            pad = (-n) % chunk
+            if pad:
+                empty = _empty_batch_like(data, columns)
+                ident_ctx = HostBatchContext(empty, batch_index=n)
+                ident = tuple(a.host_partial(ident_ctx) for a in analyzers)
+                partials.extend([ident] * pad)
+            program = _ingest_program(analyzers)
+            for start in range(0, len(partials), chunk):
+                group = partials[start:start + chunk]
+                stacked = tuple(
+                    jax.tree_util.tree_map(
+                        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *[p[i] for p in group],
+                    )
+                    for i in range(len(analyzers))
+                )
+                states = program(states, stacked)
+                monitor.device_updates += 1
+        host_side = _fetch_states_packed(states)
         return host_side, host_states

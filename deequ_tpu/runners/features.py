@@ -21,7 +21,29 @@ from ..analyzers.base import FeatureSpec
 from ..data import Batch, ColumnKind
 from ..expr import evaluate_predicate
 from ..ops.hashing import hash_column
-from ..ops.hll import hll_features
+from ..ops.hll import hll_pack_features
+
+
+def _hll_packed(col) -> np.ndarray:
+    """uint16 HLL ingest feature for one column — native C++ single pass
+    (hash + clz + pack) when built, numpy fallback otherwise."""
+    from ..native import native_hll_pack_numeric, native_hll_pack_strings
+    from ..ops.hashing import DEFAULT_SEED
+
+    if col.kind == ColumnKind.STRING:
+        if native_hll_pack_strings is not None and col.values.dtype == object:
+            return native_hll_pack_strings(col.values, col.mask, DEFAULT_SEED)
+    elif col.kind == ColumnKind.BOOLEAN or col.kind.is_numeric:
+        if native_hll_pack_numeric is not None:
+            vals = col.values
+            if vals.dtype == np.bool_ or (
+                np.issubdtype(vals.dtype, np.integer) and vals.dtype != np.int64
+            ):
+                vals = vals.astype(np.int64)
+            if np.issubdtype(vals.dtype, np.number):
+                return native_hll_pack_numeric(vals, col.mask, DEFAULT_SEED)
+    hashes = hash_column(col.values, col.mask, col.kind)
+    return hll_pack_features(hashes, col.mask)
 
 # reference regexes (`analyzers/catalyst/StatefulDataType.scala:36-38`);
 # decision order: null -> fractional -> integral -> boolean -> string
@@ -125,10 +147,15 @@ class FeatureBuilder:
                 features[key] = batch.row_mask
             elif spec.kind == "num":
                 col = batch.column(spec.column)
-                vals = col.numeric_f64()
-                # zero only masked-out positions; genuine NaN/inf values at
-                # valid positions propagate (Spark semantics)
-                features[key] = np.where(col.mask, vals, 0.0)
+                if np.issubdtype(col.values.dtype, np.number):
+                    # zero-copy passthrough: masked-out positions may carry
+                    # arbitrary bytes (Arrow leaves null slots undefined) —
+                    # every device consumer masks before use, so no host
+                    # copy is needed; genuine NaN/inf at valid positions
+                    # propagate (Spark semantics)
+                    features[key] = col.values
+                else:
+                    features[key] = col.numeric_f64()
             elif spec.kind == "mask":
                 col = batch.column(spec.column)
                 features[key] = col.mask
@@ -145,9 +172,7 @@ class FeatureBuilder:
                 col = batch.column(spec.column)
                 features[key] = hash_column(col.values, col.mask, col.kind)
             elif spec.kind == "hll":
-                col = batch.column(spec.column)
-                hashes = hash_column(col.values, col.mask, col.kind)
-                features[key] = hll_features(hashes)
+                features[key] = _hll_packed(batch.column(spec.column))
             elif spec.kind == "pred":
                 if pred_columns is None:
                     pred_columns = _predicate_columns(batch)

@@ -6,6 +6,9 @@ through cluster code paths (`SparkContextSpec.scala:75-84`)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# deterministic placement: tests exercise the device-stream path by default
+# (the host ingest tier has explicit placement="host" tests)
+os.environ.setdefault("DEEQU_TPU_PLACEMENT", "device")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

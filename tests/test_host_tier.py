@@ -1,0 +1,156 @@
+"""Host ingest tier (native block partials + device fold) must produce the
+same metrics as the device-streaming path — the framework's placement choice
+is a performance decision, never a semantic one. Mirrors the reference's
+partial-aggregation-per-partition + merge execution split
+(`AnalysisRunner.scala:303-318`, SURVEY.md §2.9)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    Completeness,
+    Compliance,
+    Correlation,
+    DataType,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+@pytest.fixture(scope="module")
+def mixed_data():
+    rng = np.random.default_rng(3)
+    n = 20000
+    x = rng.normal(50, 10, n)
+    xnull = rng.random(n) < 0.1
+    y = rng.normal(-1, 2, n)
+    cats = rng.integers(0, 500, n)
+    strs = np.array(
+        [None if rng.random() < 0.05 else f"v{int(i)}" for i in cats], dtype=object
+    )
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "x": pa.array(x, mask=xnull),
+                "y": pa.array(y),
+                "cat": pa.array(cats),
+                "s": pa.array(strs.tolist()),
+            }
+        )
+    )
+
+
+BATTERY = [
+    Size(),
+    Size(where="x > 50"),
+    Completeness("x"),
+    Compliance("pos", "y > 0"),
+    PatternMatch("s", r"v\d+"),
+    Mean("x"),
+    Sum("x"),
+    Minimum("x"),
+    Maximum("x"),
+    StandardDeviation("x"),
+    Correlation("x", "y"),
+    MinLength("s"),
+    MaxLength("s"),
+    DataType("s"),
+    ApproxCountDistinct("cat"),
+    ApproxCountDistinct("s"),
+    Mean("x", where="y > 0"),
+]
+
+
+class TestHostTierEquivalence:
+    def test_metrics_match_device_path(self, mixed_data):
+        dev = AnalysisRunner.do_analysis_run(
+            mixed_data, BATTERY, batch_size=4096, placement="device"
+        )
+        host = AnalysisRunner.do_analysis_run(
+            mixed_data, BATTERY, batch_size=4096, placement="host"
+        )
+        for a in BATTERY:
+            dv = dev.metric(a).value
+            hv = host.metric(a).value
+            assert dv.is_success == hv.is_success, a
+            if dv.is_success and isinstance(dv.get(), float):
+                assert hv.get() == pytest.approx(dv.get(), rel=1e-9, abs=1e-12), a
+
+    def test_hll_registers_bit_exact(self, mixed_data):
+        a = ApproxCountDistinct("cat")
+        dev = AnalysisRunner.do_analysis_run(mixed_data, [a], placement="device")
+        host = AnalysisRunner.do_analysis_run(mixed_data, [a], placement="host")
+        assert dev.metric(a).value.get() == host.metric(a).value.get()
+
+    def test_kll_quantiles_within_bounds(self, mixed_data):
+        a = ApproxQuantile("x", 0.5)
+        host = AnalysisRunner.do_analysis_run(
+            mixed_data, [a], batch_size=4096, placement="host"
+        )
+        med = host.metric(a).value.get()
+        truth = np.nanquantile(
+            np.where(
+                np.asarray(mixed_data.arrow["x"].is_valid()),
+                mixed_data.arrow["x"].to_numpy(zero_copy_only=False),
+                np.nan,
+            ),
+            0.5,
+        )
+        # rank error 1% of 20k rows around a dense normal: generous envelope
+        assert abs(med - truth) < 1.0
+
+    def test_single_device_fold(self, mixed_data):
+        mon = RunMonitor()
+        AnalysisRunner.do_analysis_run(
+            mixed_data, BATTERY, batch_size=2048, monitor=mon, placement="host"
+        )
+        assert mon.passes == 1
+        assert mon.batches == -(-mixed_data.num_rows // 2048)
+        # ALL batches fold in ONE device execution (the ingest program)
+        assert mon.device_updates == 1
+
+    def test_empty_dataset(self):
+        data = Dataset.from_dict({"x": np.array([], dtype=np.float64)})
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Size(), Mean("x"), Minimum("x")], placement="host"
+        )
+        assert ctx.metric(Size()).value.get() == 0.0
+        assert not ctx.metric(Mean("x")).value.is_success
+
+    def test_incremental_state_merge_across_tiers(self, mixed_data):
+        """States produced by the host tier merge cleanly with device-tier
+        states (same pytree contract)."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        half = mixed_data.num_rows // 2
+        first = Dataset.from_arrow(mixed_data.arrow.slice(0, half))
+        second = Dataset.from_arrow(mixed_data.arrow.slice(half))
+        battery = [Size(), Mean("x"), StandardDeviation("x")]
+
+        sp = InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(
+            first, battery, save_states_with=sp, placement="host"
+        )
+        ctx = AnalysisRunner.do_analysis_run(
+            second, battery, aggregate_with=sp, placement="device"
+        )
+        full = AnalysisRunner.do_analysis_run(mixed_data, battery, placement="device")
+        for a in battery:
+            assert ctx.metric(a).value.get() == pytest.approx(
+                full.metric(a).value.get(), rel=1e-9
+            ), a
