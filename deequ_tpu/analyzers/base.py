@@ -296,11 +296,16 @@ class HostBatchContext:
             else:
                 v = vals[mask].astype(np.float64)
                 if v.size == 0:
-                    cached = np.array([0.0, 0.0, 0.0, 0.0, 0.0])
+                    cached = np.array([0.0, 0.0, np.nan, np.nan, 0.0])
                 else:
+                    # NaN-largest order, matching the native kernel and the
+                    # device update: NaN never wins the min (no non-NaN
+                    # values -> identity NaN); any NaN wins the max
+                    nonnan = v[~np.isnan(v)]
+                    mn = nonnan.min() if nonnan.size else np.nan
+                    mx = np.nan if nonnan.size < v.size else v.max()
                     cached = np.array(
-                        [v.size, v.sum(), v.min(), v.max(),
-                         ((v - v.mean()) ** 2).sum()]
+                        [v.size, v.sum(), mn, mx, ((v - v.mean()) ** 2).sum()]
                     )
             self._pred_cache[key] = cached
         return cached
@@ -384,8 +389,12 @@ class StandardScanShareableAnalyzer(ScanShareableAnalyzer[S, DoubleMetric]):
             value = self.metric_value(state)
         except Exception as exc:  # noqa: BLE001
             return metric_from_failure(wrap_if_necessary(exc), self.name, self.instance, self.entity)
-        if value is None or (isinstance(value, float) and np.isnan(value)):
+        if value is None:
             return metric_from_empty(self.name, self.instance, self.entity)
+        # a NaN from a NON-empty state is a real result (Spark: max/sum/avg
+        # over data containing NaN is NaN; corr with zero variance is NaN)
+        # and surfaces as Success(NaN), exactly as the reference's agg row
+        # does — emptiness is decided solely by `is_empty`/None
         return metric_from_value(float(value), self.name, self.instance, self.entity)
 
     @abc.abstractmethod

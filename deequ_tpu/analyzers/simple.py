@@ -52,6 +52,7 @@ from .states import (
     NumMatchesAndCount,
     StandardDeviationState,
     SumState,
+    min_nan_largest,
 )
 
 
@@ -63,12 +64,27 @@ def _masked_sum(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jnp.where(mask, values, 0).astype(ACC_DTYPE))
 
 
-def _masked_min(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    return jnp.min(jnp.where(mask, values, np.inf).astype(ACC_DTYPE))
-
-
 def _masked_max(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(jnp.where(mask, values, -np.inf).astype(ACC_DTYPE))
+
+
+# Minimum follows Spark's NaN-largest total order (reals < +inf < NaN): a
+# NaN value never wins a min, and the min over ONLY NaNs is NaN. NaN is
+# therefore the top — and identity — element of this order, which is why
+# MinState.init() is NaN (an empty state merges as a no-op) and why there is
+# no plain masked-min helper here (it would silently reintroduce IEEE NaN
+# propagation). Maximum needs no such machinery: IEEE max propagation (any
+# NaN -> NaN) IS NaN-largest semantics for max, and -inf stays its identity.
+# The pairwise `min_nan_largest` lives in states.py next to MinState.
+
+
+def _masked_min_nl(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Batch min under the NaN-largest order: NaN values are skipped; a
+    batch with no non-NaN valid value reduces to the identity NaN."""
+    v = values.astype(ACC_DTYPE)
+    m = mask & ~jnp.isnan(v)
+    mn = jnp.min(jnp.where(m, v, np.inf))
+    return jnp.where(jnp.any(m), mn, np.nan)
 
 
 def _np_count(n) -> np.ndarray:
@@ -391,11 +407,16 @@ class Minimum(_NumericColumnAnalyzer):
 
     def host_partial(self, ctx) -> MinState:
         count, _s, mn, _mx, _m2 = ctx.block_stats(self, self.column)
-        return MinState(_np_acc(mn if count > 0 else np.inf), _np_count(count))
+        # block_stats reports the NaN-largest min: NaN when the block holds
+        # no non-NaN valid value — exactly MinState's identity
+        return MinState(_np_acc(mn), _np_count(count))
 
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
-        return MinState(jnp.minimum(state.min_value, _masked_min(v, mask)), state.count + _count(mask))
+        return MinState(
+            min_nan_largest(state.min_value, _masked_min_nl(v, mask)),
+            state.count + _count(mask),
+        )
 
     def merge(self, a, b):
         return a.merge(b)
@@ -424,6 +445,9 @@ class Maximum(_NumericColumnAnalyzer):
 
     def update(self, state, features):
         v, mask = self._values_and_mask(features)
+        # any valid NaN wins the max (NaN-largest order): jnp.max/jnp.maximum
+        # propagate it; masked-out rows are replaced by -inf first, so a
+        # null-row NaN never leaks in
         return MaxState(jnp.maximum(state.max_value, _masked_max(v, mask)), state.count + _count(mask))
 
     def merge(self, a, b):
@@ -487,13 +511,14 @@ class MinLength(_LengthAnalyzer):
         lengths = ctx.string_lengths(self.column)
         mask = ctx.column_mask(self, self.column)
         n = int(np.count_nonzero(mask))
-        mn = float(lengths[mask].min()) if n else np.inf
+        mn = float(lengths[mask].min()) if n else np.nan  # NaN = MinState identity
         return MinState(_np_acc(mn), _np_count(n))
 
     def update(self, state, features):
         lengths, mask = self._lengths_and_mask(features)
         return MinState(
-            jnp.minimum(state.min_value, _masked_min(lengths, mask)), state.count + _count(mask)
+            min_nan_largest(state.min_value, _masked_min_nl(lengths, mask)),
+            state.count + _count(mask),
         )
 
 

@@ -249,7 +249,12 @@ def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
     while stride * k < nv:
         stride <<= 1
         h += 1
-    r = (np.uint32(tick) * np.uint32(2654435761)) >> np.uint32(7)
+    # batch index XOR valid-count mixing, bit-identical to the native
+    # block_kll_sample_f64 (periodic streams must not phase-lock the stride)
+    r = (
+        (np.uint32(tick) * np.uint32(2654435761))
+        ^ (np.uint32(nv) * np.uint32(2246822519))
+    ) >> np.uint32(7)
     offset = int(r % np.uint32(stride))
     picked = np.sort(vv[offset::stride])[:k]
     items[: picked.size] = picked
@@ -302,17 +307,28 @@ class KLLSketch(_KLLBackedAnalyzer):
             start = float(state.g_min)
             end = float(state.g_max)
             nb = self.params.number_of_buckets
-            buckets = []
+            count = int(state.count)
             # bucket i covers (low_i, high_i]; the last bucket includes its
-            # upper bound (reference `analyzers/KLLSketch.scala:136-146`)
-            for i in range(nb):
-                low = start + (end - start) * i / nb
-                high = start + (end - start) * (i + 1) / nb
-                if i == nb - 1:
-                    cnt = sketch.rank(high) - sketch.rank_exclusive(low)
-                else:
-                    cnt = sketch.rank_exclusive(high) - sketch.rank_exclusive(low)
-                buckets.append(BucketValue(low, high, int(cnt)))
+            # upper bound (reference `analyzers/KLLSketch.scala:136-146`).
+            # The batch pre-collapse drops remainder items (n mod stride), so
+            # the sketch's total weight can drift slightly below the exact
+            # value count; scale the cumulative ranks so bucket counts
+            # telescope to EXACTLY `count`, like the reference sketch whose
+            # compactions preserve total weight (`NonSampleCompactor.scala:
+            # 29-69`).
+            bounds = [start + (end - start) * i / nb for i in range(nb + 1)]
+            raw = [sketch.rank_exclusive(b) for b in bounds[:-1]]
+            # the final cumulative is the FULL sketch weight, not
+            # rank(g_max): f32-quantized items can round a hair above the
+            # f64 g_max and must still land in the last bucket
+            raw.append(sketch.total_weight)
+            tw = sketch.total_weight
+            scale = (count / tw) if tw else 0.0
+            cum = [int(np.floor(r * scale + 0.5)) for r in raw]
+            buckets = [
+                BucketValue(bounds[i], bounds[i + 1], cum[i + 1] - cum[i])
+                for i in range(nb)
+            ]
             dist = BucketDistribution(
                 buckets,
                 [self.params.shrinking_factor, float(self._sketch_size())],

@@ -26,6 +26,14 @@ def _i(x: int) -> jnp.ndarray:
     return jnp.asarray(x, dtype=COUNT_DTYPE)
 
 
+def min_nan_largest(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise min under Spark's NaN-largest total order (reals < +inf <
+    NaN): NaN never wins, making it the identity — and the init value — of
+    MinState. The single definition serves both the device update path
+    (analyzers/simple.py) and state merges, so the two cannot drift."""
+    return jnp.where(jnp.isnan(a), b, jnp.where(jnp.isnan(b), a, jnp.minimum(a, b)))
+
+
 @flax.struct.dataclass
 class NumMatches:
     """Row-count state (reference `analyzers/Size.scala:23-29`)."""
@@ -115,10 +123,16 @@ class MinState:
 
     @staticmethod
     def init() -> "MinState":
-        return MinState(_f(np.inf), _i(0))
+        # NaN is the identity (top) element of the NaN-largest min order the
+        # reference uses (Spark TypeUtils: reals < +inf < NaN); see
+        # `min_nan_largest` below
+        return MinState(_f(np.nan), _i(0))
 
     def merge(self, other: "MinState") -> "MinState":
-        return MinState(jnp.minimum(self.min_value, other.min_value), self.count + other.count)
+        return MinState(
+            min_nan_largest(self.min_value, other.min_value),
+            self.count + other.count,
+        )
 
     def metric_value(self) -> float:
         return float(self.min_value)

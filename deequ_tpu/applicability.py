@@ -15,11 +15,7 @@ import numpy as np
 
 from .analyzers import Analyzer
 from .checks import Check
-from .constraints import (
-    AnalysisBasedConstraint,
-    Constraint,
-    ConstraintDecorator,
-)
+from .constraints import Constraint
 from .data import ColumnKind, ColumnSchema, Dataset, Schema
 
 NUM_RECORDS = 1000  # reference `Applicability.scala:240`
@@ -79,11 +75,6 @@ class Applicability:
         failures: Dict[str, Optional[BaseException]] = {}
         for check_result in result.check_results.values():
             for cr in check_result.constraint_results:
-                inner = (
-                    cr.constraint.inner
-                    if isinstance(cr.constraint, ConstraintDecorator)
-                    else cr.constraint
-                )
                 metric_failed = cr.metric is not None and cr.metric.value.is_failure
                 missing = cr.metric is None
                 applicable = not (metric_failed or missing)
@@ -94,11 +85,18 @@ class Applicability:
                         if cr.metric is not None and cr.metric.value.is_failure
                         else RuntimeError(cr.message or "missing metric")
                     )
-                    name = (
-                        str(inner.analyzer)
-                        if isinstance(inner, AnalysisBasedConstraint)
-                        else str(cr.constraint)
-                    )
+                    # keyed by the CONSTRAINT's string, as the reference does
+                    # (`Applicability.scala:176-177` maps
+                    # `constraint.toString -> constraint`), so two failing
+                    # constraints sharing one analyzer keep distinct entries;
+                    # the reference returns a Seq and tolerates duplicate
+                    # names — a dict needs a disambiguating suffix instead
+                    name = str(cr.constraint)
+                    if name in failures:
+                        i = 2
+                        while f"{name} #{i}" in failures:
+                            i += 1
+                        name = f"{name} #{i}"
                     failures[name] = exc
         return CheckApplicability(
             not failures, failures, constraint_applicabilities

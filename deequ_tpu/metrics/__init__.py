@@ -219,10 +219,13 @@ class KLLMetric(Metric[BucketDistribution]):
 
 
 def metric_from_value(value: float, name: str, instance: str, entity: Entity) -> DoubleMetric:
-    if value is None or (isinstance(value, float) and math.isnan(value)):
+    if value is None:
         return metric_from_failure(
-            ValueError(f"metric {name} on {instance} produced NaN"), name, instance, entity
+            ValueError(f"metric {name} on {instance} produced no value"), name, instance, entity
         )
+    # NaN is a legitimate successful value (Spark: max/sum/avg over data
+    # containing NaN, corr at zero variance); emptiness/failure is decided
+    # by the caller, never inferred from the value here
     return DoubleMetric(entity, name, instance, Success(float(value)))
 
 

@@ -263,21 +263,28 @@ void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
 // (`analyzers/simple.py` StandardDeviation/Correlation.update).
 // ---------------------------------------------------------------------------
 
+// NaN semantics (uniform with the device update and the numpy fallback in
+// HostBatchContext.block_stats — Spark's NaN-largest total order): NaN never
+// wins the min (min is NaN only when NO non-NaN value exists, which is also
+// the MinState identity); ANY NaN wins the max; sum/m2 propagate NaN.
 #define BLOCK_STATS_IMPL(NAME, T)                                            \
   void NAME(const T* v, const uint8_t* m, int64_t n, double* out) {          \
     /* out: [count, sum, min, max, m2] */                                    \
     double sum = 0.0, mn = 0.0, mx = 0.0;                                    \
-    int64_t count = 0;                                                       \
+    int64_t count = 0, nonnan = 0;                                           \
+    bool any_nan = false;                                                    \
     for (int64_t i = 0; i < n; ++i) {                                        \
       if (m != nullptr && !m[i]) continue;                                   \
       double x = (double)v[i];                                               \
-      if (count == 0) { mn = x; mx = x; }                                    \
+      sum += x;                                                              \
+      ++count;                                                               \
+      if (x != x) { any_nan = true; continue; }                              \
+      if (nonnan == 0) { mn = x; mx = x; }                                   \
       else {                                                                 \
         if (x < mn) mn = x;                                                  \
         if (x > mx) mx = x;                                                  \
       }                                                                      \
-      sum += x;                                                              \
-      ++count;                                                               \
+      ++nonnan;                                                              \
     }                                                                        \
     double m2 = 0.0;                                                         \
     if (count > 0) {                                                         \
@@ -288,10 +295,11 @@ void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
         m2 += d * d;                                                         \
       }                                                                      \
     }                                                                        \
+    double qnan = __builtin_nan("");                                         \
     out[0] = (double)count;                                                  \
     out[1] = sum;                                                            \
-    out[2] = mn;                                                             \
-    out[3] = mx;                                                             \
+    out[2] = nonnan > 0 ? mn : qnan;                                         \
+    out[3] = any_nan ? qnan : (nonnan > 0 ? mx : qnan);                      \
     out[4] = m2;                                                             \
   }
 
@@ -400,7 +408,11 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
   int64_t h = 0;
   int64_t stride = 1;
   while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
-  uint32_t r = (tick * 2654435761u) >> 7;
+  // offset mixes the batch index AND the valid-value count so a stream
+  // whose structure is periodic in the batch size cannot stay phase-locked
+  // with the sampler (must match _np_kll_sample in analyzers/sketches.py
+  // bit-for-bit)
+  uint32_t r = ((tick * 2654435761u) ^ ((uint32_t)nv * 2246822519u)) >> 7;
   int64_t offset = (int64_t)(r % (uint32_t)stride);
   int64_t taken = 0, seen = 0;
   for (int64_t i = 0; i < n && taken < k; ++i) {

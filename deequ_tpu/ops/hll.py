@@ -194,7 +194,15 @@ def estimate_cardinality(registers: np.ndarray) -> float:
         estimate = h if h <= THRESHOLD else e_corrected
     else:
         estimate = e_corrected
-    return float(np.rint(estimate))
+    return round_half_up(estimate)
+
+
+def round_half_up(x: float) -> float:
+    """JVM ``Math.round`` semantics: floor(x + 0.5), i.e. ties round toward
+    +inf (reference `StatefulHyperloglogPlus.count` returns
+    `Math.round(estimate)`, `:256`). numpy's ``rint`` rounds half-to-even and
+    diverges on exact .5 boundaries."""
+    return float(np.floor(x + 0.5))
 
 
 def _estimate_bias(e: float) -> float:

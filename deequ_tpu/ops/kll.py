@@ -43,6 +43,16 @@ from ..config import ACC_DTYPE, COUNT_DTYPE
 #: of magnitude finer than the sketch's RANK error, and f32 sorts run on the
 #: TPU's native path instead of emulated f64. min/max/count stay ACC/COUNT
 #: dtype for exact parity.
+#:
+#: DOCUMENTED CAVEAT (persistence): the reference persists KLL items as
+#: doubles (`analyzers/catalyst/KLLSketchSerializer.scala:26-121`); here the
+#: state's item buffers are f32 on device and persist as f32, so a persisted
+#: sketch's item VALUES can differ from a double-precision payload by up to
+#: 1 ulp of f32 (~1.2e-7 relative). Round-trips through the state providers
+#: are bit-exact with respect to the sketch's own contents (asserted in
+#: tests/test_state_serde.py); the f32 quantisation happens once, at update
+#: time, and is far inside the sketch's rank-error envelope. g_min/g_max and
+#: the exact count persist at full f64/i64 precision.
 ITEM_DTYPE = jnp.float32
 
 #: defaults matching the reference (`analyzers/KLLSketch.scala:172-176`)

@@ -114,6 +114,11 @@ def _parse_int(series: pd.Series) -> pd.Series:
     def parse(v):
         if v is None or (isinstance(v, float) and np.isnan(v)):
             return None
+        if isinstance(v, (float, np.floating)):
+            # a numeric value (incl. an int column pandas widened to float64
+            # because of nulls): Spark's numeric->int cast truncates; inf
+            # cannot cast and marks the row invalid, it must not raise
+            return int(v) if np.isfinite(v) else None
         try:
             return int(str(v).strip())
         except ValueError:
@@ -166,6 +171,16 @@ class RowLevelSchemaValidator:
                 parsed = _parse_int(col)
                 ok = is_null | parsed.notna().to_numpy()
                 matches &= ok
+                # DOCUMENTED DIVERGENCE: nulls pass the min bound here, as
+                # they do the max bound. The reference's min-bound CNF reads
+                # `colIsNull.isNull.or(colAsInt.geq(value))`
+                # (`RowLevelSchemaValidator.scala:246`) — `colIsNull.isNull`
+                # is constant-false (isNull of a non-null boolean expr), so
+                # there a NULL row FAILS minValue while PASSING maxValue
+                # (`:250` uses the plain `colIsNull.or(...)`). That asymmetry
+                # is an apparent typo, not a semantic choice; this build uses
+                # the symmetric nullable semantics for both bounds, with
+                # non-nullability enforced separately via `is_nullable`.
                 if cd.min_value is not None:
                     ge = parsed.map(lambda v: v is not None and v >= cd.min_value)
                     matches &= is_null | ge.to_numpy()

@@ -1,0 +1,241 @@
+"""Regression tests for advisor findings (ADVICE.md rounds 1 and 2).
+
+Each test pins a previously-divergent behavior to the reference semantics so
+it cannot silently regress:
+
+- round-1: Math.round half-up parity for HLL estimates; per-constraint
+  applicability failure keys; KLL persistence round-trip exactness; KLL
+  bucket-count rescale to the exact value count; schema null-bound semantics
+  (documented divergence).
+- round-2: uniform NaN min/max semantics across device / native-host /
+  numpy-host paths; KLL host sampler phase-mixing on periodic input; feed
+  probe + placement recording in RunMonitor.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxQuantile,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+class TestHllRoundHalfUp:
+    """Reference `StatefulHyperloglogPlus.count` ends in JVM `Math.round`
+    (half-up); numpy `rint` is half-to-even and diverges on .5 ties."""
+
+    def test_math_round_semantics(self):
+        from deequ_tpu.ops.hll import round_half_up
+
+        assert round_half_up(0.5) == 1.0   # rint: 0
+        assert round_half_up(2.5) == 3.0   # rint: 2
+        assert round_half_up(-1.5) == -1.0  # rint: -2 (Math.round(-1.5) == -1)
+        assert round_half_up(2.4) == 2.0
+        assert round_half_up(2.6) == 3.0
+
+
+class TestApplicabilityConstraintKeys:
+    """Reference keys applicability failures by `constraint.toString`
+    (`Applicability.scala:176-177`); keying by analyzer collapses two
+    failing constraints that share one analyzer."""
+
+    def test_duplicate_analyzer_failures_both_reported(self):
+        from deequ_tpu.applicability import Applicability
+        from deequ_tpu.checks import Check, CheckLevel
+        from deequ_tpu.data import ColumnKind, ColumnSchema, Schema
+
+        check = (
+            Check(CheckLevel.ERROR, "dup")
+            .has_min("s", lambda v: v > 0, hint="first")
+            .has_min("s", lambda v: v > 10, hint="second")
+        )
+        schema = Schema([ColumnSchema("s", ColumnKind.STRING, True)])
+        result = Applicability.is_applicable_check(check, schema)
+        assert not result.is_applicable
+        # both constraints failed (Minimum on a string column) and BOTH
+        # appear — previously the second overwrote the first
+        assert len(result.failures) == 2
+
+
+class TestKLLBucketRescale:
+    """The batch pre-collapse can drop remainder weight; bucket counts must
+    still telescope to the EXACT count like the reference's weight-preserving
+    compactor (`NonSampleCompactor.scala:29-69`)."""
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_bucket_counts_sum_to_exact_count(self, placement):
+        rng = np.random.default_rng(0)
+        n = 10000
+        data = Dataset.from_dict({"x": rng.normal(size=n)})
+        a = KLLSketch("x", KLLParameters(sketch_size=256, number_of_buckets=10))
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [a], batch_size=2048, placement=placement
+        )
+        dist = ctx.metric(a).value.get()
+        assert sum(b.count for b in dist.buckets) == n
+        assert all(b.count >= 0 for b in dist.buckets)
+
+
+class TestKLLPersistenceRoundTrip:
+    """Persisted KLL state must round-trip bit-exactly (the documented f32
+    item caveat lives at `ops/kll.py ITEM_DTYPE`; what IS stored must come
+    back identical)."""
+
+    def test_filesystem_round_trip_bit_exact(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        rng = np.random.default_rng(1)
+        data = Dataset.from_dict({"x": rng.normal(size=5000)})
+        a = KLLSketch("x")
+        sp = FileSystemStateProvider(str(tmp_path))
+        AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+        loaded = sp.load(a)
+        again = sp.load(a)
+        for lhs, rhs in zip(
+            (loaded.items, loaded.sizes, loaded.count, loaded.g_min, loaded.g_max),
+            (again.items, again.sizes, again.count, again.g_min, again.g_max),
+        ):
+            np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+        # min/max/count persist at full precision even though items are f32
+        assert np.asarray(loaded.g_min).dtype == np.float64
+        assert np.asarray(loaded.count).dtype == np.int64
+
+
+class TestSchemaNullBounds:
+    """Documented divergence: the reference's min-bound CNF contains the
+    constant-false `colIsNull.isNull` (`RowLevelSchemaValidator.scala:246`),
+    an apparent typo that makes NULL fail minValue but pass maxValue. This
+    build treats both bounds symmetrically: NULL passes when nullable."""
+
+    def test_null_rows_pass_both_bounds_when_nullable(self):
+        from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+
+        schema = RowLevelSchema().with_int_column(
+            "i", is_nullable=True, min_value=1, max_value=10
+        )
+        data = Dataset.from_arrow(
+            pa.table({"i": pa.array([None, 5, 0, 99], type=pa.int64())})
+        )
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 2   # None and 5
+        assert result.num_invalid_rows == 2  # 0 (< min), 99 (> max)
+
+
+NAN = float("nan")
+
+
+class TestNaNMinMaxSemantics:
+    """Spark's NaN-largest total order, uniform across device streaming,
+    native host tier and numpy host fallback: NaN never wins a min; any NaN
+    wins the max; sum/mean/stddev propagate NaN."""
+
+    def _run(self, values, placement):
+        data = Dataset.from_arrow(pa.table({"x": pa.array(values, type=pa.float64())}))
+        battery = [Minimum("x"), Maximum("x"), Mean("x"), Sum("x"), StandardDeviation("x")]
+        return AnalysisRunner.do_analysis_run(data, battery, placement=placement)
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_mixed_nan(self, placement):
+        ctx = self._run([5.0, NAN, 2.0, 9.0], placement)
+        assert ctx.metric(Minimum("x")).value.get() == 2.0
+        assert np.isnan(ctx.metric(Maximum("x")).value.get())
+        assert np.isnan(ctx.metric(Mean("x")).value.get())
+        assert np.isnan(ctx.metric(Sum("x")).value.get())
+        assert np.isnan(ctx.metric(StandardDeviation("x")).value.get())
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_all_nan(self, placement):
+        ctx = self._run([NAN, NAN], placement)
+        # Spark: min/max over all-NaN are NaN (successful metrics, not empty)
+        assert np.isnan(ctx.metric(Minimum("x")).value.get())
+        assert np.isnan(ctx.metric(Maximum("x")).value.get())
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_nulls_still_empty(self, placement):
+        data = Dataset.from_arrow(
+            pa.table({"x": pa.array([None, None], type=pa.float64())})
+        )
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Minimum("x"), Maximum("x")], placement=placement
+        )
+        assert not ctx.metric(Minimum("x")).value.is_success
+        assert not ctx.metric(Maximum("x")).value.is_success
+
+    def test_numpy_fallback_matches_native(self, monkeypatch):
+        """Third code path (host tier without the native library)."""
+        import deequ_tpu.native as native_mod
+
+        monkeypatch.setattr(native_mod, "native_block_stats", None)
+        ctx = self._run([5.0, NAN, 2.0, 9.0], "host")
+        assert ctx.metric(Minimum("x")).value.get() == 2.0
+        assert np.isnan(ctx.metric(Maximum("x")).value.get())
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_literal_inf_values_survive(self, placement):
+        """+inf/-inf are ordinary ordered values, distinct from NaN."""
+        ctx = self._run([float("inf"), float("inf")], placement)
+        assert ctx.metric(Minimum("x")).value.get() == float("inf")
+        assert ctx.metric(Maximum("x")).value.get() == float("inf")
+
+
+class TestKLLSamplerPhaseMixing:
+    """The host block sampler's stride offset mixes the valid-value count so
+    a stream periodic in the batch size cannot phase-lock the sampler."""
+
+    def test_periodic_input_quantile(self):
+        # period-16 sawtooth aligned with the stride at batch 4096, k=400
+        n = 65536
+        vals = np.tile(np.arange(16, dtype=np.float64), n // 16)
+        data = Dataset.from_dict({"x": vals})
+        a = ApproxQuantile("x", 0.5, relative_error=0.01)
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [a], batch_size=4096, placement="host"
+        )
+        med = ctx.metric(a).value.get()
+        assert abs(med - 7.5) <= 1.5  # true median of 0..15 sawtooth
+
+    def test_sorted_input_quantile(self):
+        n = 65536
+        data = Dataset.from_dict({"x": np.arange(n, dtype=np.float64)})
+        a = ApproxQuantile("x", 0.5, relative_error=0.01)
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [a], batch_size=4096, placement="host"
+        )
+        med = ctx.metric(a).value.get()
+        assert abs(med - n / 2) <= 0.02 * n
+
+
+class TestPlacementRecording:
+    """Every run records which ingest tier executed (and the probed feed
+    bandwidth when auto-placement ran) through RunMonitor."""
+
+    def test_monitor_records_placement(self):
+        data = Dataset.from_dict({"x": np.arange(100, dtype=np.float64)})
+        mon = RunMonitor()
+        AnalysisRunner.do_analysis_run(data, [Mean("x")], monitor=mon, placement="host")
+        assert mon.placement == "host"
+        mon.reset()
+        assert mon.placement is None
+        AnalysisRunner.do_analysis_run(
+            data, [Mean("x")], monitor=mon, placement="device"
+        )
+        assert mon.placement == "device"
+
+    def test_auto_placement_records_bandwidth(self):
+        data = Dataset.from_dict({"x": np.arange(100, dtype=np.float64)})
+        mon = RunMonitor()
+        AnalysisRunner.do_analysis_run(data, [Mean("x")], monitor=mon, placement="auto")
+        assert mon.feed_bandwidth_mbps is not None
+        assert mon.feed_bandwidth_mbps > 0
+        assert mon.placement in ("host", "device")
