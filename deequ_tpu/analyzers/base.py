@@ -114,6 +114,12 @@ def typeclass_feature(column: str) -> FeatureSpec:
     return FeatureSpec("type", column)
 
 
+def codes_feature(column: str) -> FeatureSpec:
+    """int32 dictionary codes of an encoded column (nulls/padding coded
+    out-of-range) — the device frequency path's input."""
+    return FeatureSpec("codes", column)
+
+
 # ---------------------------------------------------------------------------
 # Preconditions (reference `analyzers/Analyzer.scala:285-359`)
 # ---------------------------------------------------------------------------

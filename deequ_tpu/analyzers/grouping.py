@@ -43,19 +43,109 @@ from ..exceptions import (
     IllegalAnalyzerParameterException,
     wrap_if_necessary,
 )
-from .base import Analyzer, Preconditions
+from .base import Analyzer, Preconditions, ScanShareableAnalyzer
 
 COUNT_COL = "count"
 
 
+#: flush the run buffer once it holds at least this many entries (and at
+#: least as many as the merged table, so every flush is amortized against
+#: fresh input — total merge work stays O(total entries appended), never
+#: O(batches x distinct))
+MIN_FLUSH_ENTRIES = 1 << 17
+
+#: optional hard cap on the frequency table's resident entry count; a run
+#: whose distinct-group count exceeds it fails with a clear resource error
+#: (a failure METRIC via the runner, not an opaque OOM). 0 = unlimited.
+FREQ_BUDGET_ENV = "DEEQU_TPU_MAX_FREQUENCY_ENTRIES"
+
+
+class FrequencyBudgetExceeded(RuntimeError):
+    """Distinct-group count crossed DEEQU_TPU_MAX_FREQUENCY_ENTRIES."""
+
+
 class FrequenciesAndNumRows:
     """Host state: group -> count plus total row count
-    (reference `GroupingAnalyzers.scala:128-157`)."""
+    (reference `GroupingAnalyzers.scala:128-157`).
+
+    Accumulation is amortized: per-batch count runs buffer in a list and are
+    merged with ONE concat + groupby once the buffer outweighs the merged
+    table (the reference leans on Spark's hash-aggregation shuffle for the
+    same reason, `GroupingAnalyzers.scala:53-80`). The old per-batch
+    ``Series.add`` outer join re-touched every distinct group per batch —
+    quadratic over a run on high-cardinality columns.
+    """
+
+    #: total entries processed by flush merges across the process — tests
+    #: assert this stays O(total entries appended), see tests/test_grouping_scale.py
+    merge_work: int = 0
 
     def __init__(self, frequencies: pd.Series, num_rows: int, group_columns: Sequence[str]):
-        self.frequencies = frequencies  # index = group keys (tuples for multi-col)
+        self._merged = frequencies  # index = group keys (tuples for multi-col)
+        self._runs: List[pd.Series] = []
+        self._buffered = 0
         self.num_rows = int(num_rows)
         self.group_columns = list(group_columns)
+
+    @property
+    def frequencies(self) -> pd.Series:
+        """The merged frequency table (forces a flush of buffered runs)."""
+        self._flush()
+        return self._merged
+
+    @frequencies.setter
+    def frequencies(self, value: pd.Series) -> None:
+        self._merged = value
+        self._runs = []
+        self._buffered = 0
+
+    def _budget(self) -> int:
+        import os
+
+        try:
+            return int(os.environ.get(FREQ_BUDGET_ENV, "0"))
+        except ValueError:
+            return 0
+
+    def _flush(self) -> None:
+        if not self._runs:
+            return
+        parts = ([self._merged] if len(self._merged) else []) + self._runs
+        FrequenciesAndNumRows.merge_work += sum(len(p) for p in parts)
+        if len(parts) == 1:
+            merged = parts[0].astype(np.int64)
+        else:
+            cat = pd.concat(parts)
+            levels = (
+                list(range(cat.index.nlevels))
+                if isinstance(cat.index, pd.MultiIndex)
+                else 0
+            )
+            # dropna=False: NaN is a real group key (update() groups with
+            # dropna=False; a float column's NaN VALUES form a group, only
+            # nulls are excluded)
+            merged = (
+                cat.groupby(level=levels, sort=False, dropna=False)
+                .sum()
+                .astype(np.int64)
+            )
+        budget = self._budget()
+        if budget and len(merged) > budget:
+            raise FrequencyBudgetExceeded(
+                f"frequency table for {self.group_columns} holds {len(merged)} "
+                f"distinct groups, over the {FREQ_BUDGET_ENV}={budget} budget"
+            )
+        self._merged = merged
+        self._runs = []
+        self._buffered = 0
+
+    def _append_run(self, counts: pd.Series) -> None:
+        if len(counts) == 0:
+            return
+        self._runs.append(counts)
+        self._buffered += len(counts)
+        if self._buffered >= max(len(self._merged), MIN_FLUSH_ENTRIES):
+            self._flush()
 
     def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
         merged = _add_series(self.frequencies, other.frequencies)
@@ -68,24 +158,27 @@ class FrequenciesAndNumRows:
         )
 
     def update(self, batch: Batch) -> "FrequenciesAndNumRows":
-        """Fold one batch of rows into the frequency table."""
+        """Fold one batch of rows into the frequency table. O(batch) work per
+        batch (the per-batch group-by); merges amortize via `_append_run`.
+        Mutates and returns self — per-batch copies of a potentially huge
+        table are exactly the cost this accumulator exists to avoid."""
         mask = batch.row_mask
         cols = {}
         for name in self.group_columns:
             col = batch.column(name)
             mask = mask & col.mask
             cols[name] = col.values
-        num_rows = self.num_rows + batch.num_rows
+        self.num_rows += batch.num_rows
         if not mask.any():
-            return FrequenciesAndNumRows(self.frequencies, num_rows, self.group_columns)
+            return self
         frame = pd.DataFrame({n: v[mask] for n, v in cols.items()})
         counts = frame.groupby(self.group_columns, sort=False, dropna=False).size()
         if len(self.group_columns) == 1:
             counts.index = counts.index.get_level_values(0) if isinstance(
                 counts.index, pd.MultiIndex
             ) else counts.index
-        merged = _add_series(self.frequencies, counts)
-        return FrequenciesAndNumRows(merged, num_rows, self.group_columns)
+        self._append_run(counts)
+        return self
 
 
 def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
@@ -96,6 +189,93 @@ def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
     if len(b) == 0:
         return a.astype(np.int64)
     return a.add(b, fill_value=0).astype(np.int64)
+
+
+#: dictionary sizes up to this ride the fused device scan as a segment_sum;
+#: larger dictionaries fall back to the amortized host group-by
+DEVICE_FREQ_MAX_CARDINALITY = 1 << 16
+
+
+@dataclass(frozen=True)
+class DeviceFrequencyScan(ScanShareableAnalyzer):
+    """Frequency table of one dictionary-encoded column computed ON DEVICE:
+    a `segment_sum` over the column's codes joins the fused scan, so
+    low-cardinality grouping costs zero extra host work (SURVEY §7 step 6's
+    hybrid; the reference instead runs a Spark groupBy shuffle per set,
+    `GroupingAnalyzers.scala:53-80`).
+
+    Runner-internal: `AnalysisRunner` instantiates it for eligible grouping
+    sets and converts the state back into FrequenciesAndNumRows, so every
+    grouping analyzer's metric code sees one state type."""
+
+    column: str = ""
+    num_categories: int = 0
+    name: str = field(default="DeviceFrequencyScan", init=False)
+
+    supports_host_partial = True
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def feature_specs(self):
+        from .base import codes_feature, mask_feature, rows_feature
+
+        return [rows_feature(), mask_feature(self.column), codes_feature(self.column)]
+
+    def init_state(self):
+        from .states import FrequencyCountsState
+
+        return FrequencyCountsState.init(self.num_categories)
+
+    def update(self, state, features):
+        import jax
+        import jax.numpy as jnp
+
+        from .base import codes_feature, mask_feature
+
+        rows = features["rows"]
+        mask = rows & features[mask_feature(self.column).key]
+        codes = features[codes_feature(self.column).key]
+        contrib = jnp.where(mask, 1, 0)
+        batch_counts = jax.ops.segment_sum(
+            contrib, codes, num_segments=self.num_categories + 1
+        )[: self.num_categories]
+        from .states import FrequencyCountsState
+
+        return FrequencyCountsState(
+            state.counts + batch_counts.astype(state.counts.dtype),
+            state.num_rows + jnp.sum(rows, dtype=state.num_rows.dtype),
+        )
+
+    def host_partial(self, ctx):
+        from .states import FrequencyCountsState
+
+        col = ctx.batch.column(self.column)
+        mask = ctx.batch.row_mask & col.mask
+        counts = np.bincount(
+            col.codes[mask], minlength=self.num_categories + 1
+        )[: self.num_categories]
+        return FrequencyCountsState(
+            counts.astype(np.int64), np.asarray(ctx.batch.num_rows, dtype=np.int64)
+        )
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def to_frequencies(self, state, dictionary: np.ndarray) -> FrequenciesAndNumRows:
+        counts = np.asarray(state.counts)
+        nz = counts > 0
+        series = pd.Series(
+            counts[nz].astype(np.int64), index=pd.Index(np.asarray(dictionary)[nz])
+        )
+        return FrequenciesAndNumRows(series, int(state.num_rows), [self.column])
+
+    def compute_metric_from(self, state):  # pragma: no cover - runner-internal
+        raise NotImplementedError(
+            "DeviceFrequencyScan states convert via to_frequencies; the "
+            "grouping analyzers sharing the set own the metrics"
+        )
 
 
 class GroupingAnalyzer(Analyzer[FrequenciesAndNumRows, DoubleMetric]):
@@ -360,8 +540,9 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
                         _spark_string_cast(v) if v is not None else NULL_FIELD_REPLACEMENT
                     )
             counts = pd.Series(keys).value_counts(sort=False)
-        merged = state.frequencies.add(counts, fill_value=0).astype(np.int64)
-        return FrequenciesAndNumRows(merged, state.num_rows + batch.num_rows, [self.column])
+        state._append_run(counts.astype(np.int64))
+        state.num_rows += batch.num_rows
+        return state
 
     def merge(self, a: FrequenciesAndNumRows, b: FrequenciesAndNumRows) -> FrequenciesAndNumRows:
         return a.sum(b)

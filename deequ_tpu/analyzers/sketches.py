@@ -318,9 +318,10 @@ class KLLSketch(_KLLBackedAnalyzer):
             # 29-69`).
             bounds = [start + (end - start) * i / nb for i in range(nb + 1)]
             raw = [sketch.rank_exclusive(b) for b in bounds[:-1]]
-            # the final cumulative is the FULL sketch weight, not
-            # rank(g_max): f32-quantized items can round a hair above the
-            # f64 g_max and must still land in the last bucket
+            # anchor the ends at 0 and the FULL sketch weight, not at
+            # rank(g_min)/rank(g_max): f32-quantized items can round a hair
+            # past either f64 extreme and must still land in the end buckets
+            raw[0] = 0
             raw.append(sketch.total_weight)
             tw = sketch.total_weight
             scale = (count / tw) if tw else 0.0

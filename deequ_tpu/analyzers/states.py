@@ -35,6 +35,28 @@ def min_nan_largest(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 @flax.struct.dataclass
+class FrequencyCountsState:
+    """Dense per-category counts for the device frequency path (dictionary-
+    encoded grouping columns): counts[i] = rows whose code is i, plus the
+    total row count the frequency semantics require (reference
+    `GroupingAnalyzers.scala:53-80`: numRows counts ALL rows)."""
+
+    counts: jnp.ndarray    # int64[num_categories]
+    num_rows: jnp.ndarray  # int64
+
+    @staticmethod
+    def init(num_categories: int) -> "FrequencyCountsState":
+        return FrequencyCountsState(
+            jnp.zeros(num_categories, dtype=COUNT_DTYPE), _i(0)
+        )
+
+    def merge(self, other: "FrequencyCountsState") -> "FrequencyCountsState":
+        return FrequencyCountsState(
+            self.counts + other.counts, self.num_rows + other.num_rows
+        )
+
+
+@flax.struct.dataclass
 class NumMatches:
     """Row-count state (reference `analyzers/Size.scala:23-29`)."""
 

@@ -78,6 +78,10 @@ def _kind_of_arrow(t: "pa.DataType") -> ColumnKind:
         return ColumnKind.STRING
     if pa.types.is_temporal(t):
         return ColumnKind.TIMESTAMP
+    if pa.types.is_dictionary(t):
+        # a dictionary-encoded column behaves as its value type; the codes
+        # additionally feed the device frequency path (analyzers/grouping.py)
+        return _kind_of_arrow(t.value_type)
     return ColumnKind.UNKNOWN
 
 
@@ -94,15 +98,30 @@ def _kind_of_numpy(arr: np.ndarray) -> ColumnKind:
 
 
 class Column:
-    """One column slice: raw values + validity mask (True = present)."""
+    """One column slice: raw values + validity mask (True = present).
 
-    __slots__ = ("name", "kind", "values", "mask")
+    Dictionary-encoded sources additionally carry ``codes`` (int32 indices
+    into the table-wide unified ``dictionary``; nulls and padding are coded
+    ``len(dictionary)``) so frequency counting can ride the device scan as a
+    ``segment_sum`` instead of a host group-by."""
 
-    def __init__(self, name: str, kind: ColumnKind, values: np.ndarray, mask: np.ndarray):
+    __slots__ = ("name", "kind", "values", "mask", "codes", "dictionary")
+
+    def __init__(
+        self,
+        name: str,
+        kind: ColumnKind,
+        values: np.ndarray,
+        mask: np.ndarray,
+        codes: "Optional[np.ndarray]" = None,
+        dictionary: "Optional[np.ndarray]" = None,
+    ):
         self.name = name
         self.kind = kind
         self.values = values
         self.mask = mask
+        self.codes = codes
+        self.dictionary = dictionary
 
     def numeric_f64(self) -> np.ndarray:
         """float64 view with NaN at nulls — the device-facing representation."""
@@ -173,6 +192,10 @@ class Dataset:
     """
 
     def __init__(self, table: "pa.Table"):
+        if any(pa.types.is_dictionary(f.type) for f in table.schema):
+            # one table-wide dictionary per column: batch slices then share
+            # a stable code space, the contract of the device frequency path
+            table = table.unify_dictionaries()
         self._table = table
         self._schema = Schema(
             [ColumnSchema(f.name, _kind_of_arrow(f.type), f.nullable) for f in table.schema]
@@ -218,6 +241,20 @@ class Dataset:
 
     def select(self, names: Sequence[str]) -> "Dataset":
         return Dataset(self._table.select(list(names)))
+
+    def dictionary_values(self, name: str) -> Optional[np.ndarray]:
+        """The table-wide unified dictionary of an encoded column, or None
+        for plain columns. Positions are the code space the per-batch
+        ``Column.codes`` index into."""
+        if name not in self._schema:
+            return None
+        t = self._table.schema.field(name).type
+        if not pa.types.is_dictionary(t):
+            return None
+        col = self._table[name]
+        if col.num_chunks == 0:
+            return np.array([], dtype=object)
+        return _decode_dictionary(col.chunk(0).dictionary, self._schema[name].kind)
 
     def with_column_cast_to_f64(self, name: str) -> "Dataset":
         """Replace a string column by its parsed-float64 version (profiler
@@ -265,6 +302,8 @@ class Dataset:
             mask = np.asarray(arr.is_valid())
         else:
             mask = np.ones(n, dtype=bool)
+        if isinstance(arr, pa.DictionaryArray):
+            return _materialize_dictionary(name, kind, arr, mask, n)
         if kind.is_numeric:
             values = _numeric_buffer_view(arr, n)
             if values is None:
@@ -339,6 +378,41 @@ def _numeric_buffer_view(arr: "pa.Array", n: int) -> Optional[np.ndarray]:
     return view[arr.offset:]
 
 
+def _decode_dictionary(dictionary: "pa.Array", kind: ColumnKind) -> np.ndarray:
+    """The single decode policy for dictionary payloads — shared by batch
+    materialization and Dataset.dictionary_values so the code->value mapping
+    cannot drift between the two."""
+    if kind.is_numeric or kind == ColumnKind.BOOLEAN:
+        return dictionary.to_numpy(zero_copy_only=False)
+    return np.asarray(dictionary.to_pylist(), dtype=object)
+
+
+def _materialize_dictionary(
+    name: str, kind: ColumnKind, arr: "pa.DictionaryArray", mask: np.ndarray, n: int
+) -> Column:
+    """Decode values AND keep the (unified) codes for the device frequency
+    path. Nulls get the out-of-range code len(dictionary), which the
+    segment_sum scatter drops."""
+    import pyarrow.compute as pc
+
+    dict_vals = _decode_dictionary(arr.dictionary, kind)
+    num_cats = len(dict_vals)
+    # widen BEFORE filling: the null sentinel num_cats may not fit the
+    # dictionary's narrow index type (e.g. int8 indices, 128 categories)
+    codes = np.asarray(
+        pc.fill_null(arr.indices.cast(pa.int32()), num_cats).to_numpy(
+            zero_copy_only=False
+        ),
+        dtype=np.int32,
+    )
+    safe = np.where(codes < num_cats, codes, 0)
+    if num_cats:
+        values = dict_vals[safe]
+    else:
+        values = np.empty(n, dtype=dict_vals.dtype)
+    return Column(name, kind, values, mask, codes=codes, dictionary=dict_vals)
+
+
 def _pad_column(col: Column, size: int) -> Column:
     m = len(col.values)
     pad = size - m
@@ -352,4 +426,9 @@ def _pad_column(col: Column, size: int) -> Column:
     else:
         values = np.zeros(size, dtype=col.values.dtype)
         values[:m] = col.values
-    return Column(col.name, col.kind, values, mask)
+    codes = None
+    if col.codes is not None:
+        # padding rows carry the null code (dropped by the scatter)
+        codes = np.full(size, len(col.dictionary), dtype=np.int32)
+        codes[:m] = col.codes
+    return Column(col.name, col.kind, values, mask, codes=codes, dictionary=col.dictionary)

@@ -126,15 +126,37 @@ class AnalysisRunner:
             if a not in scanning and a not in grouping and a not in host_accum
         ]
 
-        # one shared pass over the data
-        engine = ScanEngine(scanning, monitor=monitor, sharding=sharding, placement=placement)
         grouping_sets: Dict[Tuple[str, ...], List[GroupingAnalyzer]] = {}
         for g in grouping:
             grouping_sets.setdefault(tuple(g.grouping_columns()), []).append(g)
 
+        # single-column grouping sets over dictionary-encoded columns whose
+        # dictionary is small ride the fused DEVICE scan as a segment_sum
+        # (SURVEY §7 step 6's low-cardinality hybrid); everything else
+        # accumulates through the amortized host group-by
+        from ..analyzers.grouping import (
+            DEVICE_FREQ_MAX_CARDINALITY,
+            DeviceFrequencyScan,
+        )
+
+        device_freq: Dict[Tuple[str, ...], DeviceFrequencyScan] = {}
+        device_dicts: Dict[Tuple[str, ...], Any] = {}
+        for cols in grouping_sets:
+            if len(cols) == 1:
+                dictionary = data.dictionary_values(cols[0])
+                if dictionary is not None and len(dictionary) <= DEVICE_FREQ_MAX_CARDINALITY:
+                    device_freq[cols] = DeviceFrequencyScan(cols[0], len(dictionary))
+                    device_dicts[cols] = dictionary
+
+        # one shared pass over the data
+        scan_battery = scanning + list(device_freq.values())
+        engine = ScanEngine(scan_battery, monitor=monitor, sharding=sharding, placement=placement)
+
         host_states: Dict[Any, Any] = {}
         host_updates: Dict[Any, Any] = {}
         for cols in grouping_sets:
+            if cols in device_freq:
+                continue
             key = ("__grouping__", cols)
             host_states[key] = FrequenciesAndNumRows.empty(list(cols))
             host_updates[key] = lambda st, batch: st.update(batch)
@@ -142,7 +164,7 @@ class AnalysisRunner:
             host_states[a] = a.host_init()
             host_updates[a] = a.host_update
 
-        need_pass = bool(scanning) or bool(host_states)
+        need_pass = bool(scan_battery) or bool(host_states)
         metrics: Dict[Analyzer, Metric] = {}
         if need_pass:
             try:
@@ -164,8 +186,17 @@ class AnalysisRunner:
                 # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
                 for a, state in zip(scanning, device_states):
                     metrics[a] = _finalize(a, state, aggregate_with, save_states_with)
+                device_freq_states = dict(
+                    zip(device_freq, device_states[len(scanning):])
+                )
                 for cols, members in grouping_sets.items():
-                    shared = host_states[("__grouping__", cols)]
+                    if cols in device_freq:
+                        scan = device_freq[cols]
+                        shared = scan.to_frequencies(
+                            device_freq_states[cols], device_dicts[cols]
+                        )
+                    else:
+                        shared = host_states[("__grouping__", cols)]
                     for a in members:
                         metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
                 for a in host_accum:

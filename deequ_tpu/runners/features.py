@@ -173,6 +173,14 @@ class FeatureBuilder:
                 features[key] = hash_column(col.values, col.mask, col.kind)
             elif spec.kind == "hll":
                 features[key] = _hll_packed(batch.column(spec.column))
+            elif spec.kind == "codes":
+                col = batch.column(spec.column)
+                if col.codes is None:
+                    raise ValueError(
+                        f"column {spec.column} is not dictionary-encoded; the "
+                        "codes feature is only valid on dictionary sources"
+                    )
+                features[key] = col.codes
             elif spec.kind == "pred":
                 if pred_columns is None:
                     pred_columns = _predicate_columns(batch)

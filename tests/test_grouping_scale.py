@@ -1,0 +1,158 @@
+"""High-cardinality frequency machinery.
+
+The reference leans on Spark's hash-aggregation shuffle for grouping
+(`analyzers/GroupingAnalyzers.scala:53-80`); this build must match that
+scalability on one host: amortized run-buffer accumulation (merge work
+O(total entries), never O(batches x distinct)), an enforced entry budget,
+and a device segment_sum path for dictionary-encoded low-cardinality sets
+(SURVEY.md §7 step 6).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import (
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.grouping import (
+    FrequenciesAndNumRows,
+    MIN_FLUSH_ENTRIES,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+class TestAmortizedAccumulation:
+    def test_merge_work_linear_in_appended(self):
+        """100 batches x 10k fresh keys: the old per-batch outer join
+        re-touched the full table every batch (~50M entries of merge work);
+        the amortized buffer must stay within a small constant of the 1M
+        appended entries."""
+        state = FrequenciesAndNumRows.empty(["k"])
+        before = FrequenciesAndNumRows.merge_work
+        per_batch, batches = 10_000, 100
+        for i in range(batches):
+            run = pd.Series(
+                np.ones(per_batch, dtype=np.int64),
+                index=pd.RangeIndex(i * per_batch, (i + 1) * per_batch),
+            )
+            state._append_run(run)
+        assert len(state.frequencies) == per_batch * batches
+        work = FrequenciesAndNumRows.merge_work - before
+        assert work <= 8 * per_batch * batches, work
+
+    def test_small_batches_buffer_below_flush_threshold(self):
+        """Low-cardinality accumulation never flushes per batch: many small
+        runs buffer until MIN_FLUSH_ENTRIES."""
+        state = FrequenciesAndNumRows.empty(["k"])
+        before = FrequenciesAndNumRows.merge_work
+        for i in range(50):
+            state._append_run(pd.Series(np.int64(1), index=pd.Index([f"v{i % 7}"])))
+        assert 50 < MIN_FLUSH_ENTRIES
+        assert FrequenciesAndNumRows.merge_work == before  # nothing flushed yet
+        assert int(state.frequencies.sum()) == 50
+        assert len(state.frequencies) == 7
+
+    def test_high_cardinality_run_end_to_end(self):
+        """A high-cardinality Uniqueness over many batches: values correct
+        and merge work bounded (the quadratic path would blow the bound)."""
+        n = 400_000
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, n, n)  # ~63% unique under birthday collisions
+        data = Dataset.from_dict({"k": keys})
+        before = FrequenciesAndNumRows.merge_work
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Uniqueness(["k"]), CountDistinct(["k"])], batch_size=8192
+        )
+        counts = pd.Series(keys).value_counts()
+        assert ctx.metric(Uniqueness(["k"])).value.get() == pytest.approx(
+            (counts == 1).sum() / n
+        )
+        assert ctx.metric(CountDistinct(["k"])).value.get() == len(counts)
+        work = FrequenciesAndNumRows.merge_work - before
+        assert work <= 10 * n, work
+
+    def test_budget_enforced_as_failure_metric(self, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_MAX_FREQUENCY_ENTRIES", "1000")
+        data = Dataset.from_dict({"k": np.arange(200_000) % 150_000})
+        ctx = AnalysisRunner.do_analysis_run(data, [Uniqueness(["k"])], batch_size=65536)
+        value = ctx.metric(Uniqueness(["k"])).value
+        assert value.is_failure
+        assert "budget" in str(value.exception)
+
+
+def _dict_encoded(values) -> Dataset:
+    arr = pa.array(values).dictionary_encode()
+    return Dataset.from_arrow(pa.table({"c": arr}))
+
+
+class TestDeviceFrequencyPath:
+    BATTERY = [
+        Uniqueness(["c"]),
+        Distinctness(["c"]),
+        CountDistinct(["c"]),
+        Entropy("c"),
+    ]
+
+    def test_dictionary_column_matches_plain_column(self):
+        rng = np.random.default_rng(11)
+        values = [f"g{int(i)}" for i in rng.integers(0, 40, 20_000)]
+        values[::97] = [None] * len(values[::97])
+        plain = Dataset.from_dict({"c": values})
+        encoded = _dict_encoded(values)
+        ctx_p = AnalysisRunner.do_analysis_run(plain, self.BATTERY, batch_size=4096)
+        ctx_e = AnalysisRunner.do_analysis_run(encoded, self.BATTERY, batch_size=4096)
+        for a in self.BATTERY:
+            assert ctx_e.metric(a).value.get() == pytest.approx(
+                ctx_p.metric(a).value.get()
+            ), a
+
+    def test_device_path_does_no_host_frequency_work(self):
+        """The dictionary-encoded grouping rides the device scan: zero
+        host-side merge work."""
+        values = [f"g{i % 30}" for i in range(30_000)]
+        encoded = _dict_encoded(values)
+        before = FrequenciesAndNumRows.merge_work
+        mon = RunMonitor()
+        ctx = AnalysisRunner.do_analysis_run(
+            encoded, self.BATTERY, batch_size=4096, monitor=mon
+        )
+        assert mon.passes == 1
+        assert FrequenciesAndNumRows.merge_work == before
+        assert ctx.metric(CountDistinct(["c"])).value.get() == 30
+
+    def test_numeric_dictionary_column(self):
+        values = (np.arange(10_000) % 12).astype(np.int64)
+        arr = pa.array(values).dictionary_encode()
+        encoded = Dataset.from_arrow(pa.table({"c": arr}))
+        ctx = AnalysisRunner.do_analysis_run(encoded, [CountDistinct(["c"]), Entropy("c")])
+        assert ctx.metric(CountDistinct(["c"])).value.get() == 12
+        assert ctx.metric(Entropy("c")).value.get() == pytest.approx(np.log(12), rel=1e-6)
+
+    def test_histogram_on_dictionary_column(self):
+        values = ["a", "b", "a", None, "c", "a"]
+        encoded = _dict_encoded(values)
+        ctx = AnalysisRunner.do_analysis_run(encoded, [Histogram("c")])
+        dist = ctx.metric(Histogram("c")).value.get()
+        assert dist.values["a"].absolute == 3
+        assert dist.values["NullValue"].absolute == 1
+
+    def test_dictionary_column_ordinary_analyzers(self):
+        """Dictionary-encoded columns work for non-grouping analyzers too
+        (completeness, distinct sketch) via the decoded values."""
+        from deequ_tpu.analyzers import ApproxCountDistinct, Completeness
+
+        values = [f"g{i % 25}" if i % 10 else None for i in range(5_000)]
+        encoded = _dict_encoded(values)
+        ctx = AnalysisRunner.do_analysis_run(
+            encoded, [Completeness("c"), ApproxCountDistinct("c")]
+        )
+        assert ctx.metric(Completeness("c")).value.get() == pytest.approx(0.9)
+        assert ctx.metric(ApproxCountDistinct("c")).value.get() == pytest.approx(25, abs=3)
