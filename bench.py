@@ -1,13 +1,17 @@
-"""Benchmark: fused single-pass analyzer scan throughput on the real device.
+"""Benchmarks on the real device, mirroring the BASELINE.json configs.
 
-Measures the BASELINE.json north-star proxy — analyzer-engine rows/sec/chip
-on a representative battery (completeness, moments, min/max, HLL distinct,
-KLL quantile sketch over multiple columns) — and compares against a
-single-core pandas/numpy oracle computing the same metrics on the same data
-(the stand-in for the reference's Spark-local per-core throughput; the
-reference publishes no numbers, BASELINE.md).
+1. **Scan battery** (BASELINE config 2 shape): fused single-pass analyzer
+   scan over a 50M-row table — completeness, moments, min/max, HLL distinct,
+   KLL quantile sketches.
+2. **Column profiler** (BASELINE config 3 shape, the north-star metric):
+   `ColumnProfilerRunner` full profile over a wide mixed-type table
+   (numeric + string + categorical columns), reporting rows/sec/chip.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Each stage compares against a single-core pandas/numpy oracle computing the
+same statistics on the same data (the stand-in for the reference's
+Spark-local per-core throughput; the reference publishes no numbers,
+BASELINE.md). Prints ONE json line with the north-star profiler metric;
+the scan-battery numbers land in the stderr tail.
 """
 
 from __future__ import annotations
@@ -23,7 +27,12 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_data(rows: int):
+# ---------------------------------------------------------------------------
+# stage 1: scan battery (BASELINE config 2)
+# ---------------------------------------------------------------------------
+
+
+def build_scan_data(rows: int):
     import pyarrow as pa
 
     rng = np.random.default_rng(42)
@@ -36,7 +45,7 @@ def build_data(rows: int):
     return pa.table(cols)
 
 
-def analyzer_battery():
+def scan_battery():
     from deequ_tpu.analyzers import (
         ApproxCountDistinct,
         Completeness,
@@ -62,20 +71,18 @@ def analyzer_battery():
     return analyzers
 
 
-def run_tpu(table, batch_size: int) -> tuple[float, dict]:
-    import jax
+def run_scan_stage(rows: int, batch_size: int) -> dict:
+    import pyarrow as pa
 
     from deequ_tpu.data import Dataset
     from deequ_tpu.runners import AnalysisRunner
-    from deequ_tpu.runners.engine import RunMonitor, probe_feed_bandwidth
+    from deequ_tpu.runners.engine import RunMonitor
 
+    log(f"[scan] building {rows:,}-row table")
+    table = build_scan_data(rows)
     data = Dataset.from_arrow(table)
-    analyzers = analyzer_battery()
-    log(f"devices: {jax.devices()}")
-    log(f"feed-link probe: {probe_feed_bandwidth():.0f} MB/s")
+    analyzers = scan_battery()
 
-    # warmup: compile the programs on one batch (placement-stable: the
-    # ingest fold has a fixed chunk shape, so this hits every program)
     warm = Dataset.from_arrow(table.slice(0, batch_size))
     AnalysisRunner.do_analysis_run(warm, analyzers, batch_size=batch_size)
 
@@ -86,58 +93,145 @@ def run_tpu(table, batch_size: int) -> tuple[float, dict]:
     )
     elapsed = time.perf_counter() - t0
     assert mon.passes == 1
-    values = {}
+    tpu_vals = {}
     for a, m in ctx.metric_map.items():
         if m.value.is_success and a.name in ("Completeness", "Mean", "Sum"):
-            values[f"{a.name}:{a.instance}"] = m.value.get()
-    return elapsed, values
+            tpu_vals[f"{a.name}:{a.instance}"] = m.value.get()
 
-
-def run_pandas_baseline(table, rows: int) -> tuple[float, dict]:
-    """Same metrics, single-core pandas/numpy on the full data."""
     df = table.to_pandas()
     t0 = time.perf_counter()
-    values = {}
+    base_vals = {}
     for i in range(4):
         c = f"x{i}"
         s = df[c]
-        values[f"Completeness:{c}"] = s.notna().mean()
-        values[f"Mean:{c}"] = s.mean()
-        values[f"Sum:{c}"] = s.sum()
+        base_vals[f"Completeness:{c}"] = s.notna().mean()
+        base_vals[f"Mean:{c}"] = s.mean()
+        base_vals[f"Sum:{c}"] = s.sum()
         s.min(); s.max(); s.std(ddof=0)
     df["cat"].nunique()
     np.nanquantile(df["x0"].to_numpy(), np.linspace(0.01, 1, 100))
     np.nanquantile(df["x1"].to_numpy(), np.linspace(0.01, 1, 100))
-    elapsed = time.perf_counter() - t0
-    return elapsed, values
+    base_s = time.perf_counter() - t0
 
-
-def main() -> None:
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000_000
-    batch_size = 1 << 20
-    log(f"building {rows:,}-row table")
-    table = build_data(rows)
-
-    tpu_s, tpu_vals = run_tpu(table, batch_size)
-    log(f"tpu pass: {tpu_s:.2f}s ({rows / tpu_s / 1e6:.2f}M rows/s)")
-    base_s, base_vals = run_pandas_baseline(table, rows)
-    log(f"measured single-core pandas baseline: {base_s:.2f}s")
-
-    # metric parity guard: same answers as the oracle (±1e-6 relative)
     for k, v in base_vals.items():
         tv = tpu_vals[k]
         if abs(tv - v) > 1e-6 * max(1.0, abs(v)):
             log(f"PARITY MISMATCH {k}: tpu={tv} oracle={v}")
             sys.exit(1)
+    rate = rows / elapsed
+    phases = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(mon.phase_seconds.items()))
+    log(
+        f"[scan] {rows:,} rows x {len(analyzers)} analyzers: {elapsed:.2f}s "
+        f"({rate/1e6:.2f}M rows/s/chip), single-core pandas {base_s:.2f}s "
+        f"-> {rate/(rows/base_s):.1f}x"
+    )
+    log(f"[scan] placement={mon.placement} phases: {phases}")
+    return {"rows_per_sec": rate, "vs_single_core": rate / (rows / base_s)}
 
-    rows_per_sec = rows / tpu_s
+
+# ---------------------------------------------------------------------------
+# stage 2: column profiler on a wide mixed table (BASELINE config 3)
+# ---------------------------------------------------------------------------
+
+N_NUMERIC = 16
+N_STRING = 4
+N_CAT = 4
+
+
+def build_wide_data(rows: int):
+    import pyarrow as pa
+
+    rng = np.random.default_rng(7)
+    cols = {}
+    for i in range(N_NUMERIC):
+        vals = rng.normal(10 * i, 1 + i, rows)
+        if i % 3 == 0:
+            cols[f"n{i}"] = pa.array(vals, mask=rng.random(rows) < 0.02)
+        else:
+            cols[f"n{i}"] = pa.array(vals)
+    base = np.array([f"id_{i:07d}" for i in range(100_000)])
+    for i in range(N_STRING):
+        cols[f"s{i}"] = pa.array(base[rng.integers(0, len(base), rows)])
+    for i in range(N_CAT):
+        card = 20 * (i + 1)
+        cats = np.array([f"c{j}" for j in range(card)])
+        cols[f"c{i}"] = pa.array(cats[rng.integers(0, card, rows)])
+    return pa.table(cols)
+
+
+def run_profile_stage(rows: int) -> dict:
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.profiles import ColumnProfilerRunner
+    from deequ_tpu.runners.engine import RunMonitor
+
+    n_cols = N_NUMERIC + N_STRING + N_CAT
+    log(f"[profile] building {rows:,}-row x {n_cols}-col mixed table")
+    table = build_wide_data(rows)
+    data = Dataset.from_arrow(table)
+
+    # warmup on a slice: compile every program shape the profile needs
+    warm = Dataset.from_arrow(table.slice(0, 1 << 18))
+    ColumnProfilerRunner.on_data(warm).run()
+
+    t0 = time.perf_counter()
+    profiles = ColumnProfilerRunner.on_data(data).run()
+    elapsed = time.perf_counter() - t0
+    rate = rows / elapsed
+
+    # single-core pandas oracle: the same per-column statistics
+    df = table.to_pandas()
+    t0 = time.perf_counter()
+    base_vals = {}
+    for name in df.columns:
+        s = df[name]
+        s.notna().mean()
+        nunique = s.nunique()
+        if s.dtype.kind == "f":
+            base_vals[name] = (s.mean(), s.min(), s.max(), s.std(ddof=0), s.sum())
+            np.nanquantile(s.to_numpy(), np.linspace(0.01, 1, 100))
+        if nunique <= 120:
+            s.value_counts()
+    base_s = time.perf_counter() - t0
+
+    # parity guard on the numeric profiles
+    for name, (mean, mn, mx, std, total) in base_vals.items():
+        p = profiles.profiles[name]
+        for got, want in ((p.mean, mean), (p.minimum, mn), (p.maximum, mx),
+                          (p.std_dev, std), (p.sum, total)):
+            if abs(got - want) > 1e-6 * max(1.0, abs(want)):
+                log(f"PARITY MISMATCH {name}: got={got} want={want}")
+                sys.exit(1)
+    complete = len(profiles.profiles)
+    log(
+        f"[profile] {rows:,} rows x {n_cols} cols ({complete} profiled): "
+        f"{elapsed:.2f}s ({rate/1e6:.2f}M rows/s/chip), single-core pandas "
+        f"{base_s:.2f}s -> {rate/(rows/base_s):.1f}x"
+    )
+    return {"rows_per_sec": rate, "vs_single_core": rate / (rows / base_s)}
+
+
+def main() -> None:
+    import jax
+
+    from deequ_tpu.runners.engine import probe_feed_bandwidth
+
+    scan_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000_000
+    profile_rows = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000_000
+    log(f"devices: {jax.devices()}")
+    log(f"feed-link probe: {probe_feed_bandwidth():.0f} MB/s")
+
+    scan = run_scan_stage(scan_rows, batch_size=1 << 20)
+    profile = run_profile_stage(profile_rows)
+
     print(
         json.dumps(
             {
-                "metric": "analyzer_scan_rows_per_sec_per_chip",
-                "value": round(rows_per_sec, 1),
+                "metric": "column_profiler_rows_per_sec_per_chip",
+                "value": round(profile["rows_per_sec"], 1),
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / (rows / base_s), 2),
+                "vs_baseline": round(profile["vs_single_core"], 2),
+                "scan_rows_per_sec_per_chip": round(scan["rows_per_sec"], 1),
+                "scan_vs_baseline": round(scan["vs_single_core"], 2),
             }
         )
     )

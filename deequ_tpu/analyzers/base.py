@@ -323,7 +323,7 @@ class HostBatchContext:
             from ..runners.features import string_lengths
 
             col = self.batch.column(column)
-            cached = string_lengths(col.values, col.mask)
+            cached = string_lengths(col.string_source, col.mask)
             self._pred_cache[key] = cached
         return cached
 
@@ -333,8 +333,11 @@ class HostBatchContext:
         if cached is None:
             from ..runners.features import classify_type_codes
 
+            from ..data import ColumnKind
+
             col = self.batch.column(column)
-            cached = classify_type_codes(col.values, col.mask, col.kind)
+            source = col.string_source if col.kind == ColumnKind.STRING else col.values
+            cached = classify_type_codes(source, col.mask, col.kind)
             self._pred_cache[key] = cached
         return cached
 

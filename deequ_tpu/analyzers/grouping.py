@@ -530,16 +530,33 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
                     pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
                 ).astype(np.int64)
         else:
-            keys = np.empty(len(values), dtype=object)
-            for i in range(len(values)):
-                if not present[i]:
-                    keys[i] = NULL_FIELD_REPLACEMENT
-                else:
-                    v = self.binning_func(values[i])
-                    keys[i] = (
-                        _spark_string_cast(v) if v is not None else NULL_FIELD_REPLACEMENT
-                    )
-            counts = pd.Series(keys).value_counts(sort=False)
+            # bin the DISTINCT values, not every row: the binning function is
+            # a pure value->bin mapping (the reference's binning UDF carries
+            # the same assumption), so counting raw values first and binning
+            # each distinct once turns an O(rows) python loop into
+            # O(distinct) — the no-binning path's cost profile
+            present_values = values[present]
+            if present_values.dtype == object:
+                vc = pd.Series(present_values).value_counts(sort=False, dropna=False)
+                distinct, cnts = list(vc.index), vc.to_numpy()
+            else:
+                distinct, cnts = np.unique(present_values, return_counts=True)
+            keys = []
+            for v in distinct:
+                b = self.binning_func(v)
+                keys.append(
+                    _spark_string_cast(b) if b is not None else NULL_FIELD_REPLACEMENT
+                )
+            counts = (
+                pd.Series(cnts, index=keys, dtype=np.int64)
+                .groupby(level=0, sort=False)
+                .sum()
+            )
+            num_null = int(len(values) - present.sum())
+            if num_null:
+                counts = counts.add(
+                    pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
+                ).astype(np.int64)
         state._append_run(counts.astype(np.int64))
         state.num_rows += batch.num_rows
         return state

@@ -98,9 +98,12 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
 
         col = ctx.batch.column(self.column)
         mask = ctx.column_mask(self, self.column)
-        if col.kind == ColumnKind.STRING and col.values.dtype == object:
-            if native_block_hll_strings is not None:
-                regs = native_block_hll_strings(col.values, mask, DEFAULT_SEED)
+        if col.kind == ColumnKind.STRING:
+            src = col.string_source
+            if native_block_hll_strings is not None and (
+                not isinstance(src, np.ndarray) or src.dtype == object
+            ):
+                regs = native_block_hll_strings(src, mask, DEFAULT_SEED)
                 return ApproxCountDistinctState(regs.astype(np.int32))
         elif native_block_hll is not None and (
             col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN

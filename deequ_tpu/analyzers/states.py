@@ -200,10 +200,12 @@ class StandardDeviationState:
         return StandardDeviationState(n, avg, jnp.where(n == 0, 0.0, m2))
 
     def metric_value(self) -> float:
+        # host math only: a jnp op on a fetched numpy state would dispatch a
+        # device program (one ~100ms round trip per metric on tunnel links)
         n = float(self.n)
         if n == 0:
             return float("nan")
-        return float(jnp.sqrt(self.m2 / self.n))
+        return float(np.sqrt(float(self.m2) / n))
 
 
 @flax.struct.dataclass
@@ -242,7 +244,10 @@ class CorrelationState:
     def metric_value(self) -> float:
         if float(self.n) == 0:
             return float("nan")
-        return float(self.ck / jnp.sqrt(self.x_mk * self.y_mk))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return float(
+                float(self.ck) / np.sqrt(float(self.x_mk) * float(self.y_mk))
+            )
 
 
 @flax.struct.dataclass

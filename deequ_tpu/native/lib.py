@@ -51,10 +51,16 @@ _lib.block_kll_sample_f64.argtypes = [
 ]
 
 
-def _arrow_layout(values: np.ndarray):
+def _arrow_layout(values):
     """(data u8[:], offsets i64[n+1], valid u8[n]) from an object array of
-    str/None."""
-    arr = pa.array(values, type=pa.large_string(), from_pandas=True)
+    str/None OR directly from a pyarrow string array (no per-value python
+    object materialization — the fast path for lazy string columns)."""
+    if isinstance(values, pa.Array):
+        arr = values
+        if not pa.types.is_large_string(arr.type):
+            arr = arr.cast(pa.large_string())  # widens offsets only
+    else:
+        arr = pa.array(values, type=pa.large_string(), from_pandas=True)
     buffers = arr.buffers()  # [validity, offsets, data]
     n = len(arr)
     offsets = np.frombuffer(buffers[1], dtype=np.int64, count=n + 1 + arr.offset)

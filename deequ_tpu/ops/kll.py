@@ -122,32 +122,47 @@ def _compact_cascade(items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray
     """One upward sweep: any level holding more than ``k`` items is sorted,
     every-2nd item of its even-length prefix is promoted to the next level
     with doubled weight, the odd tail stays (the batched analog of the
-    reference compactor, `analyzers/NonSampleCompactor.scala:29-69`)."""
+    reference compactor, `analyzers/NonSampleCompactor.scala:29-69`).
+
+    Each level is wrapped in a ``lax.cond``: in a typical fold only the one
+    level that just received an append can overflow, so the other ~31
+    levels skip their sort entirely — this is what makes the chunked ingest
+    fold (32 scan steps x many sketches) cheap. Untouched levels keep their
+    insertion order; every consumer (compaction itself, HostKLL,
+    compactor_buffers) sorts, so only the multiset per level matters."""
     levels, buf_len = items.shape
     half = buf_len // 2  # max items a compaction can emit
     slots = jnp.arange(half, dtype=jnp.int32)
     buf_slots = jnp.arange(buf_len, dtype=jnp.int32)
 
-    def body(lvl, carry):
+    def compact_level(lvl, carry):
         items, sizes, parity = carry
         n = sizes[lvl]
-        need = n > k
         buf = jnp.sort(items[lvl])
         n2 = n - (n & 1)
-        m_emit = jnp.where(need, n2 // 2, 0)
+        m_emit = n2 // 2
         off = parity[lvl]
         # promoted items: buf[off + 2j] for j < m_emit (a sorted prefix)
         emit_idx = jnp.clip(off + 2 * slots, 0, buf_len - 1)
         emitted = jnp.where(slots < m_emit, buf[emit_idx], _INF)
         # tail kept at this level: buf[n2:n] (0 or 1 items)
-        tail_count = jnp.where(need, n - n2, n)
-        tail_idx = jnp.clip(jnp.where(need, n2, 0) + buf_slots, 0, buf_len - 1)
+        tail_count = n - n2
+        tail_idx = jnp.clip(n2 + buf_slots, 0, buf_len - 1)
         new_row = jnp.where(buf_slots < tail_count, buf[tail_idx], _INF)
         items = items.at[lvl].set(new_row)
         sizes = sizes.at[lvl].set(tail_count.astype(jnp.int32))
-        parity = parity.at[lvl].set(jnp.where(need, 1 - off, off))
+        parity = parity.at[lvl].set(1 - off)
         items, sizes = _append_level(items, sizes, lvl + 1, emitted, m_emit)
         return items, sizes, parity
+
+    def body(lvl, carry):
+        _items, _sizes, _parity = carry
+        return jax.lax.cond(
+            _sizes[lvl] > k,
+            lambda c: compact_level(lvl, c),
+            lambda c: c,
+            carry,
+        )
 
     # one compiled level-step instead of L-1 unrolled copies; a single
     # upward sweep suffices because level l+1 is processed after receiving

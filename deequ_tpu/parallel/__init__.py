@@ -90,6 +90,73 @@ def sharded_update(analyzers: Sequence[Any], mesh: Mesh):
     )
 
 
+_SHARDED_INGEST_CACHE: dict = {}
+
+
+def sharded_ingest_fold(analyzers: Sequence[Any], mesh: Mesh, states_stacked, partials_stacked):
+    """Fold a chunk of host-computed partials into PER-DEVICE states over the
+    mesh: the stacked partials (leading dim = n_dev * local_chunk) shard over
+    the row axis, and each device lax.scans its local slice into its own
+    state copy — the executor-side partial-aggregation split composed WITH
+    data parallelism (reference `AnalysisRunner.scala:303-318` + Spark's
+    partition parallelism). Finish a run by merging the per-device states
+    with :func:`collective_merge_states`.
+
+    ``states_stacked``: tuple (per analyzer) of pytrees with leading n_dev
+    dim. Returns the updated stacked states."""
+    key = (tuple(analyzers), tuple(mesh.devices.flat))
+    program = _SHARDED_INGEST_CACHE.get(key)
+    if program is None:
+        def spec_of(tree):
+            # jnp.asarray reads ndim without a D2H transfer of device leaves
+            return jax.tree_util.tree_map(
+                lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), tree
+            )
+
+        def local_fold(states, stacked):
+            def body(s, partial_slice):
+                new = tuple(
+                    a.ingest_partial(si, pi)
+                    for a, si, pi in zip(analyzers, s, partial_slice)
+                )
+                return new, None
+
+            local = jax.tree_util.tree_map(lambda x: x[0], states)
+            out, _ = jax.lax.scan(body, local, stacked)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+
+        program = jax.jit(
+            jax.shard_map(
+                local_fold,
+                mesh=mesh,
+                in_specs=(spec_of(states_stacked), spec_of(partials_stacked)),
+                out_specs=spec_of(states_stacked),
+                check_vma=False,
+            ),
+            donate_argnums=0,  # states are dead after the fold, like the
+            # single-device _ingest_program — no per-chunk state copies
+        )
+        _SHARDED_INGEST_CACHE[key] = program
+    return program(states_stacked, partials_stacked)
+
+
+def stack_identity_states(analyzers: Sequence[Any], n_dev: int):
+    """n_dev copies of each analyzer's identity state, leading dim n_dev —
+    the initial per-device states for :func:`sharded_ingest_fold`."""
+    out = []
+    for a in analyzers:
+        ident = a.init_state()
+        out.append(
+            jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(
+                    jnp.asarray(x)[None], (n_dev,) + jnp.asarray(x).shape
+                ),
+                ident,
+            )
+        )
+    return tuple(out)
+
+
 def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_states):
     """Fold per-shard state pytrees with each analyzer's semigroup ``merge``
     in ONE collective device program — the treeReduce analog (reference

@@ -32,9 +32,10 @@ from .features import FeatureBuilder
 @dataclass
 class RunMonitor:
     """Counts execution events for scan-sharing assertions. Also records
-    which ingest tier a run executed on (``placement``) and the probed feed
-    bandwidth that drove the decision, so every run's results are
-    attributable to a code path."""
+    which ingest tier a run executed on (``placement``), the probed feed
+    bandwidth that drove the decision, and per-phase wall time
+    (``phase_seconds``) so a run's cost is attributable without external
+    tooling (SURVEY §5: lightweight phase timers)."""
 
     passes: int = 0
     batches: int = 0
@@ -42,6 +43,7 @@ class RunMonitor:
     jit_compiles: int = 0
     placement: Optional[str] = None
     feed_bandwidth_mbps: Optional[float] = None
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
 
     def reset(self) -> None:
         self.passes = 0
@@ -50,6 +52,41 @@ class RunMonitor:
         self.jit_compiles = 0
         self.placement = None
         self.feed_bandwidth_mbps = None
+        self.phase_seconds = {}
+
+    def add_phase_time(self, phase: str, seconds: float) -> None:
+        with _MONITOR_LOCK:
+            self.phase_seconds[phase] = self.phase_seconds.get(phase, 0.0) + seconds
+
+    def timed(self, phase: str):
+        """Context manager accumulating wall time under ``phase``; safe to
+        use from the prefetch/ingest worker threads."""
+        return _PhaseTimer(self, phase)
+
+
+import threading as _threading  # noqa: E402
+
+_MONITOR_LOCK = _threading.Lock()
+
+
+class _PhaseTimer:
+    __slots__ = ("monitor", "phase", "t0")
+
+    def __init__(self, monitor: RunMonitor, phase: str):
+        self.monitor = monitor
+        self.phase = phase
+
+    def __enter__(self):
+        import time
+
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self.monitor.add_phase_time(self.phase, time.perf_counter() - self.t0)
+        return False
 
 
 #: jit'd fused programs keyed by (analyzer battery, mesh) — analyzers are
@@ -114,31 +151,59 @@ def _empty_batch_like(data: Dataset, columns):
     raise AssertionError("batches() always yields at least one batch")
 
 
+#: below this many narrow bytes the second transfer's round trip costs more
+#: than the f64 upcast wastes
+_NARROW_SPLIT_BYTES = 1 << 15
+
+
 def _fetch_states_packed(states: Tuple) -> List[Any]:
-    """Device states -> host numpy pytrees via one packed D2H transfer."""
+    """Device states -> host numpy pytrees via packed D2H transfers.
+
+    In x64 mode, leaves that are natively <= 32-bit (KLL item buffers are
+    f32[levels, 4k] — by far the largest states) ship bit-exact through the
+    u8-bitcast buffer instead of being upcast to f64, halving the bytes on
+    the feed link; 64-bit leaves ride the f64 buffer as before. Both packs
+    dispatch before either blocks, so the link sees back-to-back transfers."""
     leaves, treedef = jax.tree_util.tree_flatten(states)
     if not leaves:
         return list(states)
     leaves = [jnp.asarray(l) for l in leaves]
     x64 = jax.config.jax_enable_x64
-    out_leaves = []
-    if x64:
-        flat = np.asarray(_pack_leaves_f64(leaves))
+    out_leaves: List[Any] = [None] * len(leaves)
+
+    def unpack_f64(idx: List[int], flat: np.ndarray) -> None:
         offset = 0
-        for leaf in leaves:
+        for i in idx:
+            leaf = leaves[i]
             part = flat[offset:offset + leaf.size]
-            out_leaves.append(
-                part.reshape(leaf.shape).astype(np.dtype(leaf.dtype.name))
-            )
+            out_leaves[i] = part.reshape(leaf.shape).astype(np.dtype(leaf.dtype.name))
             offset += leaf.size
-    else:
-        raw = np.asarray(_pack_leaves_u8(leaves)).tobytes()
+
+    def unpack_u8(idx: List[int], raw: bytes) -> None:
         offset = 0
-        for leaf in leaves:
+        for i in idx:
+            leaf = leaves[i]
             dtype = np.dtype(leaf.dtype.name)
             host = np.frombuffer(raw, dtype=dtype, count=leaf.size, offset=offset)
-            out_leaves.append(host.reshape(leaf.shape).copy())
+            out_leaves[i] = host.reshape(leaf.shape).copy()
             offset += leaf.size * dtype.itemsize
+
+    if not x64:
+        unpack_u8(list(range(len(leaves))), np.asarray(_pack_leaves_u8(leaves)).tobytes())
+        return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
+
+    narrow = [i for i, l in enumerate(leaves) if l.dtype.itemsize <= 4]
+    narrow_bytes = sum(leaves[i].size * leaves[i].dtype.itemsize for i in narrow)
+    if narrow_bytes < _NARROW_SPLIT_BYTES:
+        unpack_f64(list(range(len(leaves))), np.asarray(_pack_leaves_f64(leaves)))
+        return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
+
+    wide = [i for i in range(len(leaves)) if i not in set(narrow)]
+    packed_narrow = _pack_leaves_u8([leaves[i] for i in narrow])
+    packed_wide = _pack_leaves_f64([leaves[i] for i in wide]) if wide else None
+    unpack_u8(narrow, np.asarray(packed_narrow).tobytes())
+    if packed_wide is not None:
+        unpack_f64(wide, np.asarray(packed_wide))
     return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
 
@@ -252,8 +317,8 @@ class ScanEngine:
         return placement
 
     def _resolve_placement_inner(self) -> str:
-        if self.mesh is not None or not self.scan_analyzers:
-            return "device"  # sharded scans stream (partials are host-local)
+        if not self.scan_analyzers:
+            return "device"
         if not all(a.supports_host_partial for a in self.scan_analyzers):
             return "device"
         if self.placement == "host":
@@ -262,6 +327,9 @@ class ScanEngine:
             bw = probe_feed_bandwidth()
             self.monitor.feed_bandwidth_mbps = bw
             if bw < _FEED_BANDWIDTH_THRESHOLD_MBPS:
+                # composes with a mesh: host partials then shard the fold
+                # over the devices (_run_host_tier) — streaming raw columns
+                # over a slow feed would starve ALL chips at once
                 return "host"
         return "device"
 
@@ -272,15 +340,17 @@ class ScanEngine:
         """Host side of one batch: feature build + device placement. Runs on
         the prefetch thread so it overlaps the previous batch's device work
         (numpy / pyarrow / the native C++ kernels all release the GIL)."""
-        features = self.builder.build(batch)
-        if self.mesh is not None:
-            from ..parallel import shard_features
+        with self.monitor.timed("feature_build"):
+            features = self.builder.build(batch)
+        with self.monitor.timed("device_feed"):
+            if self.mesh is not None:
+                from ..parallel import shard_features
 
-            features = shard_features(
-                features, self.mesh, batch_rows=len(batch.row_mask)
-            )
-        else:
-            features = jax.device_put(features)
+                features = shard_features(
+                    features, self.mesh, batch_rows=len(batch.row_mask)
+                )
+            else:
+                features = jax.device_put(features)
         return features
 
     def run(
@@ -333,16 +403,19 @@ class ScanEngine:
                 batch, features = item
                 monitor.batches += 1
                 if features is not None:
-                    states = self._update(states, features)
+                    with monitor.timed("device_dispatch"):
+                        states = self._update(states, features)
                     monitor.device_updates += 1
-                for key, fn in update_fns.items():
-                    host_states[key] = fn(host_states[key], batch)
+                with monitor.timed("host_accumulators"):
+                    for key, fn in update_fns.items():
+                        host_states[key] = fn(host_states[key], batch)
         if cache_size_fn is not None:
             try:
                 monitor.jit_compiles = max(monitor.jit_compiles, cache_size_fn())
             except Exception:  # noqa: BLE001
                 pass
-        host_side = _fetch_states_packed(states)
+        with monitor.timed("state_fetch"):
+            host_side = _fetch_states_packed(states)
         return host_side, host_states
 
     def _run_host_tier(
@@ -368,23 +441,41 @@ class ScanEngine:
 
         monitor = self.monitor
         analyzers = tuple(self.scan_analyzers)
-        chunk = _INGEST_CHUNK
-        program = _ingest_program(analyzers)
+        mesh = self.mesh
+        if mesh is not None:
+            # mesh x host tier: per-device states, each fold shards the
+            # chunk's partials over the devices; a final collective merge
+            # combines the per-device states. The global chunk size stays
+            # ~_INGEST_CHUNK so the padding waste is mesh-independent.
+            from ..parallel import sharded_ingest_fold, stack_identity_states
+
+            n_dev = int(mesh.devices.size)
+            local_chunk = max(1, _INGEST_CHUNK // n_dev)
+            chunk = local_chunk * n_dev
+            states = stack_identity_states(analyzers, n_dev)
+            program = None
+        else:
+            chunk = _INGEST_CHUNK
+            program = _ingest_program(analyzers)
 
         def compute_partial(index: int, batch) -> Tuple:
-            ctx = HostBatchContext(batch, batch_index=index)
-            return tuple(a.host_partial(ctx) for a in analyzers)
+            with monitor.timed("host_partials"):
+                ctx = HostBatchContext(batch, batch_index=index)
+                return tuple(a.host_partial(ctx) for a in analyzers)
 
         def fold_chunk(states, group: List[Tuple]):
-            stacked = tuple(
-                jax.tree_util.tree_map(
-                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                    *[p[i] for p in group],
+            with monitor.timed("ingest_fold"):
+                stacked = tuple(
+                    jax.tree_util.tree_map(
+                        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *[p[i] for p in group],
+                    )
+                    for i in range(len(analyzers))
                 )
-                for i in range(len(analyzers))
-            )
-            monitor.device_updates += 1
-            return program(states, stacked)  # async dispatch: fold overlaps
+                monitor.device_updates += 1
+                if mesh is not None:
+                    return sharded_ingest_fold(analyzers, mesh, states, stacked)
+                return program(states, stacked)  # async dispatch: fold overlaps
 
         from collections import deque
 
@@ -408,8 +499,9 @@ class ScanEngine:
                 monitor.batches += 1
                 n += 1
                 pending.append(pool.submit(compute_partial, index, batch))
-                for key, fn in update_fns.items():
-                    host_states[key] = fn(host_states[key], batch)
+                with monitor.timed("host_accumulators"):
+                    for key, fn in update_fns.items():
+                        host_states[key] = fn(host_states[key], batch)
                 # backpressure: never let un-drained batches outgrow the
                 # window, so peak memory stays O(window), not O(dataset)
                 while len(pending) > window:
@@ -426,5 +518,19 @@ class ScanEngine:
             ident = compute_partial(n, empty)
             buffer.extend([ident] * (chunk - len(buffer)))
             states = fold_chunk(states, buffer)
-        host_side = _fetch_states_packed(states)
+        if program is not None:
+            try:
+                monitor.jit_compiles = max(
+                    monitor.jit_compiles, program._cache_size()
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        if mesh is not None:
+            # butterfly-merge the per-device states into one (the
+            # treeReduce analog, riding ICI)
+            from ..parallel import collective_merge_states
+
+            states = collective_merge_states(analyzers, mesh, states)
+        with monitor.timed("state_fetch"):
+            host_side = _fetch_states_packed(states)
         return host_side, host_states

@@ -31,8 +31,10 @@ def _hll_packed(col) -> np.ndarray:
     from ..ops.hashing import DEFAULT_SEED
 
     if col.kind == ColumnKind.STRING:
-        if native_hll_pack_strings is not None and col.values.dtype == object:
-            return native_hll_pack_strings(col.values, col.mask, DEFAULT_SEED)
+        if native_hll_pack_strings is not None:
+            src = col.string_source
+            if not isinstance(src, np.ndarray) or src.dtype == object:
+                return native_hll_pack_strings(src, col.mask, DEFAULT_SEED)
     elif col.kind == ColumnKind.BOOLEAN or col.kind.is_numeric:
         if native_hll_pack_numeric is not None:
             vals = col.values
@@ -57,17 +59,19 @@ _BOOLEAN_RE = re.compile(r"true|false")
 TYPE_NULL, TYPE_FRACTIONAL, TYPE_INTEGRAL, TYPE_BOOLEAN, TYPE_STRING = range(5)
 
 
-def classify_type_codes(values: np.ndarray, mask: np.ndarray, kind: ColumnKind) -> np.ndarray:
+def classify_type_codes(values, mask: np.ndarray, kind: ColumnKind) -> np.ndarray:
     """Per-value inferred-type codes 0..4 (Unknown/Fractional/Integral/
     Boolean/String). Non-string columns map directly from their kind, which
     matches the reference's behavior of casting values to strings first
-    (e.g. 1.5 -> "1.5" matches FRACTIONAL)."""
+    (e.g. 1.5 -> "1.5" matches FRACTIONAL). ``values`` may be a pyarrow
+    string array (buffer-direct native path, no object materialization)."""
     n = len(values)
     if kind == ColumnKind.STRING:
         from ..native import native_classify_types
 
         if native_classify_types is not None:
             return native_classify_types(values, mask)
+        values = _as_object_array(values)
         out = np.full(n, TYPE_NULL, dtype=np.int32)
         for i in range(n):
             if not mask[i]:
@@ -95,11 +99,21 @@ def classify_type_codes(values: np.ndarray, mask: np.ndarray, kind: ColumnKind) 
     return np.where(mask, np.int32(code), np.int32(TYPE_NULL)).astype(np.int32)
 
 
-def string_lengths(values: np.ndarray, mask: np.ndarray) -> np.ndarray:
+def _as_object_array(values) -> np.ndarray:
+    """Materialize a possibly-arrow string source into an object array (the
+    python fallback paths need real python values)."""
+    if isinstance(values, np.ndarray):
+        return values
+    vals = values.to_numpy(zero_copy_only=False)
+    return vals if vals.dtype == object else vals.astype(object)
+
+
+def string_lengths(values, mask: np.ndarray) -> np.ndarray:
     from ..native import native_string_lengths
 
     if native_string_lengths is not None:
         return native_string_lengths(values, mask)
+    values = _as_object_array(values)
     out = np.zeros(len(values), dtype=np.int32)
     for i in np.flatnonzero(mask):
         v = values[i]
@@ -161,13 +175,17 @@ class FeatureBuilder:
                 features[key] = col.mask
             elif spec.kind == "len":
                 col = batch.column(spec.column)
-                features[key] = string_lengths(col.values, col.mask)
+                features[key] = string_lengths(col.string_source, col.mask)
             elif spec.kind == "match":
                 col = batch.column(spec.column)
                 features[key] = regex_matches(col.values, col.mask, spec.payload)
             elif spec.kind == "type":
                 col = batch.column(spec.column)
-                features[key] = classify_type_codes(col.values, col.mask, col.kind)
+                features[key] = classify_type_codes(
+                    col.string_source if col.kind == ColumnKind.STRING else col.values,
+                    col.mask,
+                    col.kind,
+                )
             elif spec.kind == "hash":
                 col = batch.column(spec.column)
                 features[key] = hash_column(col.values, col.mask, col.kind)

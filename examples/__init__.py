@@ -1,0 +1,4 @@
+"""Runnable examples, ported from the reference's examples suite
+(`/root/reference/src/main/scala/com/amazon/deequ/examples/`). Each module
+exposes ``main()`` so the examples double as end-to-end tests
+(tests/test_examples.py — the `ExamplesTest.scala` analog)."""

@@ -1,0 +1,115 @@
+"""Distance: L-inf between KLL sketches / categorical count maps with the
+small-sample correction (reference `analyzers/Distance.scala:19-88`).
+Golden values are hand-computed CDF distances."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Distance, KLLSketch
+from deequ_tpu.data import Dataset
+from deequ_tpu.ops.kll_host import HostKLL
+from deequ_tpu.runners import AnalysisRunner
+
+
+def _kll(buffers):
+    return HostKLL.from_buffers(buffers, sketch_size=2048, shrinking_factor=0.64)
+
+
+class TestNumericalDistance:
+    def test_hand_computed_cdf_distance(self):
+        # s1 holds {1,2,3}, s2 holds {2,3,4}, all weight 1. CDFs evaluated
+        # at union {1,2,3,4}: s1 -> 1/3, 2/3, 1, 1 ; s2 -> 0, 1/3, 2/3, 1.
+        # L-inf = 1/3.
+        s1 = _kll([[1.0, 2.0, 3.0]])
+        s2 = _kll([[2.0, 3.0, 4.0]])
+        d = Distance.numerical_distance(s1, s2, correct_for_low_number_of_samples=True)
+        assert d == pytest.approx(1 / 3)
+
+    def test_weighted_levels(self):
+        # s1: items 1 (w1) and 2 (w2) -> total 3; cdf(1)=1/3, cdf(2)=1
+        # s2: item 2 (w1)             -> total 1; cdf(1)=0,   cdf(2)=1
+        s1 = _kll([[1.0], [2.0]])
+        s2 = _kll([[2.0]])
+        d = Distance.numerical_distance(s1, s2, correct_for_low_number_of_samples=True)
+        assert d == pytest.approx(1 / 3)
+
+    def test_identical_sketches_distance_zero(self):
+        s = _kll([[1.0, 5.0, 9.0]])
+        assert Distance.numerical_distance(s, s, True) == 0.0
+
+    def test_small_sample_correction_floors_at_zero(self):
+        # linf 1/3 with n=m=3: correction 1.8*sqrt(6/9) ~ 1.47 > 1/3 -> 0
+        s1 = _kll([[1.0, 2.0, 3.0]])
+        s2 = _kll([[2.0, 3.0, 4.0]])
+        assert Distance.numerical_distance(s1, s2) == 0.0
+
+    def test_from_analyzer_states(self):
+        rng = np.random.default_rng(0)
+        a = KLLSketch("x")
+        same1 = Dataset.from_dict({"x": rng.normal(size=20_000)})
+        same2 = Dataset.from_dict({"x": rng.normal(size=20_000)})
+        shifted = Dataset.from_dict({"x": rng.normal(loc=3.0, size=20_000)})
+        states = {}
+        for name, data in (("a", same1), ("b", same2), ("c", shifted)):
+            from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+            sp = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+            states[name] = sp.load(a)
+        near = Distance.numerical_distance(states["a"], states["b"], True)
+        far = Distance.numerical_distance(states["a"], states["c"], True)
+        assert near < 0.05
+        assert far > 0.5  # N(0,1) vs N(3,1): L-inf CDF distance ~ 0.87
+
+    def test_robust_variant_keeps_large_distances(self):
+        rng = np.random.default_rng(1)
+        s1 = _kll([sorted(rng.normal(size=1000))])
+        s2 = _kll([sorted(rng.normal(loc=3.0, size=1000))])
+        d = Distance.numerical_distance(s1, s2)
+        assert d > 0.7
+
+
+class TestCategoricalDistance:
+    def test_hand_computed(self):
+        s1 = {"a": 5, "b": 5}
+        s2 = {"a": 2, "b": 8}
+        # per-key mass: |0.5-0.2| = 0.3, |0.5-0.8| = 0.3 -> 0.3
+        d = Distance.categorical_distance(s1, s2, correct_for_low_number_of_samples=True)
+        assert d == pytest.approx(0.3)
+
+    def test_disjoint_keys(self):
+        d = Distance.categorical_distance(
+            {"a": 10}, {"b": 10}, correct_for_low_number_of_samples=True
+        )
+        assert d == pytest.approx(1.0)
+
+    def test_small_sample_correction(self):
+        s1 = {"a": 5, "b": 5}
+        s2 = {"a": 2, "b": 8}
+        # 0.3 - 1.8*sqrt(20/100) < 0 -> floored at 0
+        assert Distance.categorical_distance(s1, s2) == 0.0
+
+    def test_large_sample_correction_small(self):
+        s1 = {"a": 50_000, "b": 50_000}
+        s2 = {"a": 20_000, "b": 80_000}
+        d = Distance.categorical_distance(s1, s2)
+        assert d == pytest.approx(0.3 - 1.8 * np.sqrt(2e5 / 1e10), rel=1e-9)
+
+    def test_pandas_series_counts(self):
+        import pandas as pd
+
+        s1 = pd.Series({"a": 5, "b": 5})
+        s2 = pd.Series({"a": 2, "b": 8})
+        d = Distance.categorical_distance(s1, s2, correct_for_low_number_of_samples=True)
+        assert d == pytest.approx(0.3)
+
+
+class TestEmptySamples:
+    def test_empty_categorical_sample_robust_is_zero(self):
+        assert Distance.categorical_distance({}, {"a": 1}) == 0.0
+        assert Distance.categorical_distance({"a": 1}, {}) == 0.0
+
+    def test_empty_sketch_robust_is_zero(self):
+        empty = _kll([[]])
+        full = _kll([[1.0, 2.0]])
+        assert Distance.numerical_distance(empty, full) == 0.0

@@ -1,0 +1,122 @@
+"""Run every example end to end and assert on its outcome — the
+`examples/ExamplesTest.scala` analog: the examples double as the
+integration-test layer for the public API surface."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from deequ_tpu import CheckStatus
+from deequ_tpu.constraints import ConstraintStatus
+
+
+class TestExamples:
+    def test_basic_example(self, capsys):
+        from examples import basic_example
+
+        result = basic_example.main()
+        # productName has a null -> the ERROR-level isComplete fails; the
+        # URL ratio is 2/5 < 0.5 -> the WARNING check fails too
+        assert result.status == CheckStatus.ERROR
+        statuses = {
+            str(cr.constraint): cr.status
+            for check_result in result.check_results.values()
+            for cr in check_result.constraint_results
+        }
+        failed = [c for c, s in statuses.items() if s != ConstraintStatus.SUCCESS]
+        assert len(failed) == 2
+        assert "We found errors" in capsys.readouterr().out
+
+    def test_incremental_metrics_example(self):
+        from examples import incremental_metrics_example
+        from deequ_tpu.analyzers import ApproxCountDistinct, Completeness, Size
+
+        first, combined = incremental_metrics_example.main()
+        assert first.metric(Size()).value.get() == 3.0
+        assert combined.metric(Size()).value.get() == 5.0
+        assert combined.metric(ApproxCountDistinct("id")).value.get() == 5.0
+        assert combined.metric(Completeness("description")).value.get() == pytest.approx(0.4)
+
+    def test_update_metrics_on_partitioned_data_example(self):
+        from examples import update_metrics_on_partitioned_data_example
+        from deequ_tpu.analyzers import Completeness
+
+        table, updated = update_metrics_on_partitioned_data_example.main()
+        assert table.metric(Completeness("manufacturerName")).value.get() == 1.0
+        # the refreshed US partition introduced one null name (6 of 7 left)
+        assert updated.metric(Completeness("manufacturerName")).value.get() == pytest.approx(6 / 7)
+
+    def test_metrics_repository_example(self, capsys):
+        from examples import metrics_repository_example
+
+        frame = metrics_repository_example.main()
+        out = capsys.readouterr().out
+        assert "completeness of the productName column is: 0.8" in out
+        assert len(frame) == 5  # five successful integrity metrics stored
+
+    def test_anomaly_detection_example(self):
+        from examples import anomaly_detection_example
+
+        result = anomaly_detection_example.main()
+        # size jumped 2 -> 5, more than the allowed 2x increase
+        assert result.status != CheckStatus.SUCCESS
+
+    def test_data_profiling_example(self):
+        from examples import data_profiling_example
+        from deequ_tpu.profiles import NumericColumnProfile
+
+        result = data_profiling_example.main()
+        total = result.profiles["totalNumber"]
+        assert isinstance(total, NumericColumnProfile)
+        assert total.minimum == 1.0
+        assert total.maximum == 20.0
+        assert total.mean == pytest.approx(11.0)
+        assert total.data_type == "Fractional"
+        status = result.profiles["status"]
+        hist = {k: v.absolute for k, v in status.histogram.values.items()}
+        assert hist == {"DELAYED": 4, "IN_TRANSIT": 2, "UNKNOWN": 2}
+
+    def test_constraint_suggestion_example(self):
+        from examples import constraint_suggestion_example
+
+        result = constraint_suggestion_example.main()
+        suggestions = result.all_suggestions
+        assert suggestions
+        columns = {s.column_name for s in suggestions}
+        assert {"productName", "status"} <= columns
+        # every suggestion carries runnable code
+        assert all(s.code_for_constraint for s in suggestions)
+
+    def test_kll_example(self):
+        from examples import kll_example
+        from deequ_tpu.profiles import NumericColumnProfile
+
+        result = kll_example.main()
+        num_views = result.column_profiles["numViews"]
+        assert isinstance(num_views, NumericColumnProfile)
+        assert num_views.kll is not None
+        # KLLParameters(2, 0.64, 2): parameters = [shrinking_factor, sketch_size]
+        assert num_views.kll.parameters == [0.64, 2.0]
+        assert len(num_views.kll.buckets) == 2
+        assert sum(b.count for b in num_views.kll.buckets) == 5
+
+    def test_kll_check_example(self, capsys):
+        from examples import kll_check_example
+
+        result = kll_check_example.main()
+        # max 12 > 10 and sketch size 2 < 16: both constraints fail
+        assert result.status == CheckStatus.ERROR
+        failed = [
+            cr
+            for check_result in result.check_results.values()
+            for cr in check_result.constraint_results
+            if cr.status != ConstraintStatus.SUCCESS
+        ]
+        assert len(failed) == 2
+        assert "We found errors" in capsys.readouterr().out
