@@ -310,8 +310,16 @@ class ScanShareableFrequencyBasedAnalyzer(GroupingAnalyzer):
     """Base for analyzers that reduce the frequency table to a double
     (reference `GroupingAnalyzers.scala:85-123`)."""
 
+    #: an EMPTY frequency table (e.g. every grouping value null) yields an
+    #: empty metric: the reference's SUM aggregation over an empty relation
+    #: returns null -> EmptyStateException (`NullHandlingTests.scala`).
+    #: CountDistinct overrides this — COUNT over an empty relation is 0.
+    empty_frequencies_are_empty_metric: bool = True
+
     def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
         if state is None:
+            return metric_from_empty(self.name, self.instance, self.entity)
+        if self.empty_frequencies_are_empty_metric and len(state.frequencies) == 0:
             return metric_from_empty(self.name, self.instance, self.entity)
         try:
             value = self.metric_from_frequencies(state)
@@ -384,6 +392,7 @@ class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
 
     columns: Tuple[str, ...] = ()
     name: str = field(default="CountDistinct", init=False)
+    empty_frequencies_are_empty_metric = False  # COUNT of no groups is 0.0
 
     def __init__(self, columns):
         object.__setattr__(self, "columns", _as_tuple(columns))

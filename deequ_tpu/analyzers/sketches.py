@@ -301,7 +301,7 @@ class KLLSketch(_KLLBackedAnalyzer):
                 Failure(
                     EmptyStateException(
                         f"Empty state for analyzer {self.name} on {self.column}, "
-                        "all input values were None."
+                        "all input values were NULL."
                     )
                 ),
             )

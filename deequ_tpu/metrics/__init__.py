@@ -239,7 +239,7 @@ def metric_from_empty(name: str, instance: str, entity: Entity) -> DoubleMetric:
     from ..exceptions import EmptyStateException
 
     return metric_from_failure(
-        EmptyStateException(f"Empty state for analyzer {name} on {instance}, all input values were None."),
+        EmptyStateException(f"Empty state for analyzer {name} on {instance}, all input values were NULL."),
         name,
         instance,
         entity,

@@ -266,40 +266,87 @@ void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
 // NaN semantics (uniform with the device update and the numpy fallback in
 // HostBatchContext.block_stats — Spark's NaN-largest total order): NaN never
 // wins the min (min is NaN only when NO non-NaN value exists, which is also
-// the MinState identity); ANY NaN wins the max; sum/m2 propagate NaN.
+// the MinState identity); ANY nonnull NaN wins the max; sum/m2 propagate NaN.
+//
+// The loops are branchless with LANES independent accumulators so -O3
+// -march=native auto-vectorizes them (blend + fma); masked-out slots blend
+// to the identity BEFORE any arithmetic, so garbage bytes in Arrow null
+// slots (possibly NaN/inf) never poison a lane. Lane-wise summation
+// reassociates the additions; the resulting sums are at least as accurate
+// as the sequential order and well inside the engine's 1e-9 cross-path
+// tolerance.
+#define BLOCK_STATS_LANES 8
 #define BLOCK_STATS_IMPL(NAME, T)                                            \
   void NAME(const T* v, const uint8_t* m, int64_t n, double* out) {          \
     /* out: [count, sum, min, max, m2] */                                    \
-    double sum = 0.0, mn = 0.0, mx = 0.0;                                    \
-    int64_t count = 0, nonnan = 0;                                           \
-    bool any_nan = false;                                                    \
-    for (int64_t i = 0; i < n; ++i) {                                        \
-      if (m != nullptr && !m[i]) continue;                                   \
-      double x = (double)v[i];                                               \
-      sum += x;                                                              \
-      ++count;                                                               \
-      if (x != x) { any_nan = true; continue; }                              \
-      if (nonnan == 0) { mn = x; mx = x; }                                   \
-      else {                                                                 \
-        if (x < mn) mn = x;                                                  \
-        if (x > mx) mx = x;                                                  \
+    double inf = __builtin_inf(), qnan = __builtin_nan("");                  \
+    double sum_l[BLOCK_STATS_LANES], mn_l[BLOCK_STATS_LANES],                \
+        mx_l[BLOCK_STATS_LANES];                                             \
+    int64_t cnt_l[BLOCK_STATS_LANES], nan_l[BLOCK_STATS_LANES];              \
+    for (int j = 0; j < BLOCK_STATS_LANES; ++j) {                            \
+      sum_l[j] = 0.0; mn_l[j] = inf; mx_l[j] = -inf;                         \
+      cnt_l[j] = 0; nan_l[j] = 0;                                            \
+    }                                                                        \
+    int64_t main_n = n - (n % BLOCK_STATS_LANES);                            \
+    for (int64_t i = 0; i < main_n; i += BLOCK_STATS_LANES) {                \
+      for (int j = 0; j < BLOCK_STATS_LANES; ++j) {                          \
+        int64_t live = (m == nullptr) || m[i + j];          \
+        double x = (double)v[i + j];                                         \
+        int64_t isnan_ = x != x;                                             \
+        sum_l[j] += live ? x : 0.0;                                          \
+        cnt_l[j] += live;                                                    \
+        nan_l[j] += live & isnan_;                                           \
+        double xo = (live && !isnan_) ? x : inf;                             \
+        mn_l[j] = xo < mn_l[j] ? xo : mn_l[j];                               \
+        double xh = (live && !isnan_) ? x : -inf;                            \
+        mx_l[j] = xh > mx_l[j] ? xh : mx_l[j];                               \
       }                                                                      \
-      ++nonnan;                                                              \
+    }                                                                        \
+    for (int64_t i = main_n; i < n; ++i) {                                   \
+      int64_t live = (m == nullptr) || m[i];                                 \
+      double x = (double)v[i];                                               \
+      int64_t isnan_ = x != x;                                               \
+      sum_l[0] += live ? x : 0.0;                                            \
+      cnt_l[0] += live;                                                      \
+      nan_l[0] += live & isnan_;                                             \
+      double xo = (live && !isnan_) ? x : inf;                               \
+      mn_l[0] = xo < mn_l[0] ? xo : mn_l[0];                                 \
+      double xh = (live && !isnan_) ? x : -inf;                              \
+      mx_l[0] = xh > mx_l[0] ? xh : mx_l[0];                                 \
+    }                                                                        \
+    double sum = 0.0, mn = inf, mx = -inf;                                   \
+    int64_t count = 0, nans = 0;                                             \
+    for (int j = 0; j < BLOCK_STATS_LANES; ++j) {                            \
+      sum += sum_l[j];                                                       \
+      count += cnt_l[j];                                                     \
+      nans += nan_l[j];                                                      \
+      mn = mn_l[j] < mn ? mn_l[j] : mn;                                      \
+      mx = mx_l[j] > mx ? mx_l[j] : mx;                                      \
     }                                                                        \
     double m2 = 0.0;                                                         \
     if (count > 0) {                                                         \
       double mean = sum / (double)count;                                     \
-      for (int64_t i = 0; i < n; ++i) {                                      \
-        if (m != nullptr && !m[i]) continue;                                 \
-        double d = (double)v[i] - mean;                                      \
-        m2 += d * d;                                                         \
+      double m2_l[BLOCK_STATS_LANES];                                        \
+      for (int j = 0; j < BLOCK_STATS_LANES; ++j) m2_l[j] = 0.0;             \
+      for (int64_t i = 0; i < main_n; i += BLOCK_STATS_LANES) {              \
+        for (int j = 0; j < BLOCK_STATS_LANES; ++j) {                        \
+          int64_t live = (m == nullptr) || m[i + j];        \
+          double d = live ? (double)v[i + j] - mean : 0.0;                   \
+          m2_l[j] += d * d;                                                  \
+        }                                                                    \
       }                                                                      \
+      for (int64_t i = main_n; i < n; ++i) {                                 \
+        int64_t live = (m == nullptr) || m[i];                               \
+        double d = live ? (double)v[i] - mean : 0.0;                         \
+        m2_l[0] += d * d;                                                    \
+      }                                                                      \
+      for (int j = 0; j < BLOCK_STATS_LANES; ++j) m2 += m2_l[j];             \
     }                                                                        \
-    double qnan = __builtin_nan("");                                         \
+    int64_t nonnan = count - nans;                                           \
     out[0] = (double)count;                                                  \
     out[1] = sum;                                                            \
     out[2] = nonnan > 0 ? mn : qnan;                                         \
-    out[3] = any_nan ? qnan : (nonnan > 0 ? mx : qnan);                      \
+    out[3] = nans > 0 ? qnan : (nonnan > 0 ? mx : qnan);                     \
     out[4] = m2;                                                             \
   }
 
@@ -309,25 +356,56 @@ BLOCK_STATS_IMPL(block_stats_i64, int64_t)
 BLOCK_STATS_IMPL(block_stats_i32, int32_t)
 
 // Pearson co-moments for Correlation: out = [n, xsum, ysum, ck, xmk, ymk]
+// (branchless multi-lane like BLOCK_STATS_IMPL)
 void block_comoments_f64(const double* x, const double* y, const uint8_t* m,
                          int64_t n, double* out) {
+  double xs_l[BLOCK_STATS_LANES] = {0}, ys_l[BLOCK_STATS_LANES] = {0};
+  int64_t cnt_l[BLOCK_STATS_LANES] = {0};
+  int64_t main_n = n - (n % BLOCK_STATS_LANES);
+  for (int64_t i = 0; i < main_n; i += BLOCK_STATS_LANES) {
+    for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+      int64_t live = (m == nullptr) || m[i + j];
+      xs_l[j] += live ? x[i + j] : 0.0;
+      ys_l[j] += live ? y[i + j] : 0.0;
+      cnt_l[j] += live;
+    }
+  }
+  for (int64_t i = main_n; i < n; ++i) {
+    int64_t live = (m == nullptr) || m[i];
+    xs_l[0] += live ? x[i] : 0.0;
+    ys_l[0] += live ? y[i] : 0.0;
+    cnt_l[0] += live;
+  }
   double xs = 0.0, ys = 0.0;
   int64_t count = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    if (m != nullptr && !m[i]) continue;
-    xs += x[i];
-    ys += y[i];
-    ++count;
+  for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+    xs += xs_l[j]; ys += ys_l[j]; count += cnt_l[j];
   }
   double ck = 0.0, xmk = 0.0, ymk = 0.0;
   if (count > 0) {
     double xa = xs / (double)count, ya = ys / (double)count;
-    for (int64_t i = 0; i < n; ++i) {
-      if (m != nullptr && !m[i]) continue;
-      double dx = x[i] - xa, dy = y[i] - ya;
-      ck += dx * dy;
-      xmk += dx * dx;
-      ymk += dy * dy;
+    double ck_l[BLOCK_STATS_LANES] = {0}, xmk_l[BLOCK_STATS_LANES] = {0},
+        ymk_l[BLOCK_STATS_LANES] = {0};
+    for (int64_t i = 0; i < main_n; i += BLOCK_STATS_LANES) {
+      for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+        int64_t live = (m == nullptr) || m[i + j];
+        double dx = live ? x[i + j] - xa : 0.0;
+        double dy = live ? y[i + j] - ya : 0.0;
+        ck_l[j] += dx * dy;
+        xmk_l[j] += dx * dx;
+        ymk_l[j] += dy * dy;
+      }
+    }
+    for (int64_t i = main_n; i < n; ++i) {
+      int64_t live = (m == nullptr) || m[i];
+      double dx = live ? x[i] - xa : 0.0;
+      double dy = live ? y[i] - ya : 0.0;
+      ck_l[0] += dx * dy;
+      xmk_l[0] += dx * dx;
+      ymk_l[0] += dy * dy;
+    }
+    for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+      ck += ck_l[j]; xmk += xmk_l[j]; ymk += ymk_l[j];
     }
   }
   out[0] = (double)count;
@@ -338,16 +416,31 @@ void block_comoments_f64(const double* x, const double* y, const uint8_t* m,
   out[5] = ymk;
 }
 
-// HLL register update in place: regs[512] must be zero- or prior-initialized
+// HLL register update in place: regs[512] must be zero- or prior-initialized.
+// Hashes are computed 8 rows at a time into a local block first (independent
+// chains -> instruction-level parallelism); the register max-scatter stays
+// scalar (data-dependent indices). Masked-out garbage hashes harmlessly and
+// is discarded at scatter time.
 #define BLOCK_HLL_IMPL(NAME, T, TOBITS)                                      \
   void NAME(const T* v, const uint8_t* m, int64_t n, uint64_t seed,          \
             uint8_t* regs) {                                                 \
-    for (int64_t i = 0; i < n; ++i) {                                        \
+    uint64_t h[8];                                                           \
+    int64_t main_n = n - (n % 8);                                            \
+    for (int64_t i = 0; i < main_n; i += 8) {                                \
+      for (int j = 0; j < 8; ++j) h[j] = xxh64_fixed8(TOBITS(v[i + j]), seed); \
+      for (int j = 0; j < 8; ++j) {                                          \
+        if (m != nullptr && !m[i + j]) continue;                             \
+        uint32_t idx = (uint32_t)(h[j] >> (64 - HLL_P));                     \
+        uint64_t w = (h[j] << HLL_P) | (1ULL << (HLL_P - 1));                \
+        uint8_t pw = (uint8_t)(__builtin_clzll(w) + 1);                      \
+        if (pw > regs[idx]) regs[idx] = pw;                                  \
+      }                                                                      \
+    }                                                                        \
+    for (int64_t i = main_n; i < n; ++i) {                                   \
       if (m != nullptr && !m[i]) continue;                                   \
-      uint64_t bits = TOBITS(v[i]);                                          \
-      uint64_t h = xxh64_fixed8(bits, seed);                                 \
-      uint32_t idx = (uint32_t)(h >> (64 - HLL_P));                          \
-      uint64_t w = (h << HLL_P) | (1ULL << (HLL_P - 1));                     \
+      uint64_t hh = xxh64_fixed8(TOBITS(v[i]), seed);                        \
+      uint32_t idx = (uint32_t)(hh >> (64 - HLL_P));                         \
+      uint64_t w = (hh << HLL_P) | (1ULL << (HLL_P - 1));                    \
       uint8_t pw = (uint8_t)(__builtin_clzll(w) + 1);                        \
       if (pw > regs[idx]) regs[idx] = pw;                                    \
     }                                                                        \
@@ -391,20 +484,45 @@ static int cmp_f64(const void* a, const void* b) {
 void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
                           int32_t k, uint32_t tick, double* items,
                           int64_t* out_meta, double* out_minmax) {
-  // pass 1: count valid (NaN excluded, like the device path)
-  int64_t nv = 0;
-  double mn = 0.0, mx = 0.0;
-  for (int64_t i = 0; i < n; ++i) {
-    if (m != nullptr && !m[i]) continue;
-    double x = v[i];
-    if (x != x) continue;  // NaN
-    if (nv == 0) { mn = x; mx = x; }
-    else {
-      if (x < mn) mn = x;
-      if (x > mx) mx = x;
-    }
-    ++nv;
+  // pass 1: count valid (NaN excluded, like the device path) — branchless
+  // multi-lane like BLOCK_STATS_IMPL so it auto-vectorizes
+  double inf = __builtin_inf();
+  double mn_l[BLOCK_STATS_LANES], mx_l[BLOCK_STATS_LANES];
+  int64_t nv_l[BLOCK_STATS_LANES];
+  for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+    mn_l[j] = inf; mx_l[j] = -inf; nv_l[j] = 0;
   }
+  int64_t main_n = n - (n % BLOCK_STATS_LANES);
+  for (int64_t i = 0; i < main_n; i += BLOCK_STATS_LANES) {
+    for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+      int64_t live = (m == nullptr) || m[i + j];
+      double x = v[i + j];
+      int64_t ok = live & (x == x);
+      nv_l[j] += ok;
+      double xo = ok ? x : inf;
+      mn_l[j] = xo < mn_l[j] ? xo : mn_l[j];
+      double xh = ok ? x : -inf;
+      mx_l[j] = xh > mx_l[j] ? xh : mx_l[j];
+    }
+  }
+  for (int64_t i = main_n; i < n; ++i) {
+    int64_t live = (m == nullptr) || m[i];
+    double x = v[i];
+    int64_t ok = live & (x == x);
+    nv_l[0] += ok;
+    double xo = ok ? x : inf;
+    mn_l[0] = xo < mn_l[0] ? xo : mn_l[0];
+    double xh = ok ? x : -inf;
+    mx_l[0] = xh > mx_l[0] ? xh : mx_l[0];
+  }
+  int64_t nv = 0;
+  double mn = inf, mx = -inf;
+  for (int j = 0; j < BLOCK_STATS_LANES; ++j) {
+    nv += nv_l[j];
+    mn = mn_l[j] < mn ? mn_l[j] : mn;
+    mx = mx_l[j] > mx ? mx_l[j] : mx;
+  }
+  if (nv == 0) { mn = 0.0; mx = 0.0; }
   int64_t h = 0;
   int64_t stride = 1;
   while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }

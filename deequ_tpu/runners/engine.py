@@ -362,7 +362,35 @@ class ScanEngine:
         columns: Optional[Sequence[str]] = None,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Run the shared pass. Returns (device states per scan analyzer,
-        host accumulator states keyed as given)."""
+        host accumulator states keyed as given).
+
+        Set ``DEEQU_TPU_PROFILE_DIR`` to capture a ``jax.profiler`` trace of
+        every pass into that directory (SURVEY §5's optional profiler hook;
+        view with tensorboard or Perfetto). The lightweight phase timers in
+        RunMonitor are always on."""
+        import contextlib
+        import os
+
+        profile_dir = os.environ.get("DEEQU_TPU_PROFILE_DIR")
+        if profile_dir:
+            import jax.profiler
+
+            tracer = jax.profiler.trace(profile_dir)
+        else:
+            tracer = contextlib.nullcontext()
+        with tracer:
+            return self._run_inner(
+                data, batch_size, host_accumulators, host_update_fns, columns
+            )
+
+    def _run_inner(
+        self,
+        data: Dataset,
+        batch_size: Optional[int] = None,
+        host_accumulators: Optional[Dict[Any, Any]] = None,
+        host_update_fns: Optional[Dict[Any, Any]] = None,
+        columns: Optional[Sequence[str]] = None,
+    ) -> Tuple[List[Any], Dict[Any, Any]]:
         monitor = self.monitor
         monitor.passes += 1
         bs = batch_size or min(DEFAULT_BATCH_SIZE, max(int(data.num_rows), 1))

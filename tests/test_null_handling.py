@@ -1,0 +1,136 @@
+"""All-null column handling across every analyzer — the
+`analyzers/NullHandlingTests.scala` analog: aggregates over columns whose
+values are ALL null must produce empty-state failure metrics (never crashes,
+never fake zeros), with the documented exceptions (Size, Completeness,
+DataType, CountDistinct, ApproxCountDistinct)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    Completeness,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    MutualInformation,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.exceptions import EmptyStateException
+from deequ_tpu.runners import AnalysisRunner
+
+
+@pytest.fixture(scope="module", params=["device", "host"])
+def ctx(request):
+    """8 rows; stringCol / numericCol / numericCol2 all null, numericCol3
+    populated (reference `NullHandlingTests.dataWithNullColumns`), computed
+    through both ingest tiers."""
+    n = 8
+    data = Dataset.from_arrow(
+        pa.table(
+            {
+                "stringCol": pa.array([None] * n, type=pa.string()),
+                "numericCol": pa.array([None] * n, type=pa.float64()),
+                "numericCol2": pa.array([None] * n, type=pa.float64()),
+                "numericCol3": pa.array([float(i + 1) for i in range(n)]),
+            }
+        )
+    )
+    battery = [
+        Size(),
+        Completeness("stringCol"),
+        Mean("numericCol"),
+        StandardDeviation("numericCol"),
+        Minimum("numericCol"),
+        Maximum("numericCol"),
+        MinLength("stringCol"),
+        MaxLength("stringCol"),
+        DataType("stringCol"),
+        Sum("numericCol"),
+        ApproxQuantile("numericCol", 0.5),
+        CountDistinct(["stringCol"]),
+        ApproxCountDistinct("stringCol"),
+        Entropy("stringCol"),
+        Uniqueness(["stringCol"]),
+        Distinctness(["stringCol"]),
+        MutualInformation(["numericCol", "numericCol2"]),
+        Correlation("numericCol", "numericCol2"),
+        Correlation("numericCol", "numericCol3"),
+    ]
+    return AnalysisRunner.do_analysis_run(data, battery, placement=request.param)
+
+
+def _assert_empty_state(metric):
+    assert metric.value.is_failure, metric
+    assert isinstance(metric.value.exception, EmptyStateException), metric
+
+
+class TestNullColumnsProduceCorrectMetrics:
+    def test_size_counts_all_rows(self, ctx):
+        assert ctx.metric(Size()).value.get() == 8.0
+
+    def test_completeness_is_zero(self, ctx):
+        assert ctx.metric(Completeness("stringCol")).value.get() == 0.0
+
+    @pytest.mark.parametrize(
+        "analyzer",
+        [
+            Mean("numericCol"),
+            StandardDeviation("numericCol"),
+            Minimum("numericCol"),
+            Maximum("numericCol"),
+            MinLength("stringCol"),
+            MaxLength("stringCol"),
+            Sum("numericCol"),
+            ApproxQuantile("numericCol", 0.5),
+        ],
+        ids=lambda a: a.name,
+    )
+    def test_aggregates_fail_with_empty_state(self, ctx, analyzer):
+        _assert_empty_state(ctx.metric(analyzer))
+
+    def test_datatype_is_all_unknown(self, ctx):
+        dist = ctx.metric(DataType("stringCol")).value.get()
+        assert dist.values["Unknown"].ratio == 1.0
+
+    def test_count_distinct_is_zero(self, ctx):
+        assert ctx.metric(CountDistinct(["stringCol"])).value.get() == 0.0
+
+    def test_approx_count_distinct_is_zero(self, ctx):
+        assert ctx.metric(ApproxCountDistinct("stringCol")).value.get() == 0.0
+
+    @pytest.mark.parametrize(
+        "analyzer",
+        [
+            Entropy("stringCol"),
+            Uniqueness(["stringCol"]),
+            Distinctness(["stringCol"]),
+            MutualInformation(["numericCol", "numericCol2"]),
+            Correlation("numericCol", "numericCol2"),
+            Correlation("numericCol", "numericCol3"),
+        ],
+        ids=lambda a: f"{a.name}-{a.instance}",
+    )
+    def test_frequency_and_pair_aggregates_fail_with_empty_state(self, ctx, analyzer):
+        _assert_empty_state(ctx.metric(analyzer))
+
+    def test_empty_state_message_names_the_analyzer(self, ctx):
+        message = str(ctx.metric(Mean("numericCol")).value.exception)
+        # reference wording: "Empty state for analyzer Mean(numericCol,None),
+        # all input values were NULL."
+        assert "Mean" in message
+        assert "numericCol" in message
+        assert "all input values were NULL." in message
