@@ -1,0 +1,241 @@
+"""End-to-end VerificationSuite scenarios, ported from the reference's
+`VerificationSuiteTest.scala` (the 417-LoC integration layer): status
+aggregation across check levels in any order, required analyzers alongside
+checks, anomaly-check wiring with explicit configs and history windows,
+state persistence hooks, repository conflict semantics, and constraint
+ordering guarantees."""
+
+import math
+
+import pytest
+
+from deequ_tpu import (
+    AnomalyCheckConfig,
+    Check,
+    CheckLevel,
+    CheckStatus,
+    DoubleMetric,
+    Entity,
+    InMemoryMetricsRepository,
+    ResultKey,
+    Success,
+    VerificationSuite,
+)
+from deequ_tpu.analyzers import (
+    Completeness,
+    MutualInformation,
+    Size,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.anomalydetection import AbsoluteChangeStrategy
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners.context import AnalyzerContext
+
+
+def _df_with_n_rows(n: int) -> Dataset:
+    return Dataset.from_dict(
+        {"item": [f"{i}" for i in range(n)], "att1": [f"v{i}" for i in range(n)]}
+    )
+
+
+class TestStatusAggregation:
+    """The suite status is the max over check statuses, independent of the
+    order checks were added (reference `:60-85`)."""
+
+    def _checks(self):
+        return [
+            Check(CheckLevel.ERROR, "group-1").has_size(lambda s: s == 12),  # succeeds
+            Check(CheckLevel.WARNING, "group-2-W").has_completeness(
+                "att2", lambda v: v > 0.8
+            ),  # warns (att2 completeness is 8/12)
+            Check(CheckLevel.ERROR, "group-2-E").has_size(lambda s: s > 50),  # errors
+        ]
+
+    @pytest.mark.parametrize("order", [(0, 1, 2), (2, 1, 0), (1, 2, 0)])
+    def test_error_dominates_in_any_order(self, df_missing, order):
+        checks = self._checks()
+        suite = VerificationSuite.on_data(df_missing)
+        for i in order:
+            suite = suite.add_check(checks[i])
+        assert suite.run().status == CheckStatus.ERROR
+
+    def test_warning_when_no_error(self, df_missing):
+        result = (
+            VerificationSuite.on_data(df_missing)
+            .add_check(self._checks()[0])
+            .add_check(self._checks()[1])
+            .run()
+        )
+        assert result.status == CheckStatus.WARNING
+
+
+class TestRequiredAnalyzers:
+    def test_mandatory_analysis_alongside_checks(self, df_full):
+        """(reference `:87-122`) — required analyzers of every entity kind
+        run in the same pass and land in the suite metrics."""
+        check = (
+            Check(CheckLevel.ERROR, "group-1")
+            .is_complete("att1")
+            .has_completeness("att1", lambda v: v == 1.0)
+        )
+        result = (
+            VerificationSuite.on_data(df_full)
+            .add_check(check)
+            .add_required_analyzers(
+                [Size(), Completeness("att2"), Uniqueness(["att2"]),
+                 MutualInformation(["att1", "att2"])]
+            )
+            .run()
+        )
+        assert result.status == CheckStatus.SUCCESS
+        metrics = result.metrics
+        assert metrics[Size()].value.get() == 4.0
+        assert metrics[Completeness("att2")].value.get() == 1.0
+        # att2 = [c, d, d, f]: two singleton groups of four rows
+        assert metrics[Uniqueness(["att2"])].value.get() == 0.5
+        # att1 = [a, b, a, a], att2 = [c, d, d, f]
+        mi = metrics[MutualInformation(["att1", "att2"])].value.get()
+        pxy = [0.25, 0.25, 0.25, 0.25]
+        px = {"a": 0.75, "b": 0.25}
+        py = {"c": 0.25, "d": 0.5, "f": 0.25}
+        want = (
+            0.25 * math.log(0.25 / (px["a"] * py["c"]))
+            + 0.25 * math.log(0.25 / (px["b"] * py["d"]))
+            + 0.25 * math.log(0.25 / (px["a"] * py["d"]))
+            + 0.25 * math.log(0.25 / (px["a"] * py["f"]))
+        )
+        assert mi == pytest.approx(want, rel=1e-9)
+
+    def test_runs_with_no_constraints(self, df_full):
+        """(reference `:125-140`) — a suite with only required analyzers
+        still computes metrics."""
+        result = VerificationSuite.on_data(df_full).add_required_analyzer(Size()).run()
+        assert result.status == CheckStatus.SUCCESS
+        assert result.metrics[Size()].value.get() == 4.0
+
+
+class TestRepositorySemantics:
+    def test_new_results_preferred_on_conflict(self, df_numeric):
+        """(reference `:225-249`) — saveOrAppend overwrites conflicting
+        previous metrics for the same key."""
+        repository = InMemoryMetricsRepository()
+        key = ResultKey(0, {})
+        stale = AnalyzerContext(
+            {Size(): DoubleMetric(Entity.DATASET, "Size", "*", Success(100.0))}
+        )
+        repository.save(key, stale)
+
+        result = (
+            VerificationSuite.on_data(df_numeric)
+            .use_repository(repository)
+            .add_required_analyzers([Size(), Completeness("item")])
+            .save_or_append_result(key)
+            .run()
+        )
+        loaded = repository.load_by_key(key)
+        assert loaded.metric(Size()).value.get() == 6.0  # not the stale 100.0
+        assert loaded.metric(Completeness("item")).value.get() == result.metrics[
+            Completeness("item")
+        ].value.get()
+
+
+class TestAnomalyCheckWiring:
+    """(reference `:251-287` + `evaluateWithRepositoryWithHistory`)."""
+
+    def _repository_with_history(self) -> InMemoryMetricsRepository:
+        repository = InMemoryMetricsRepository()
+        for ts in (1, 2):
+            repository.save(
+                ResultKey(ts, {"Region": "EU"}),
+                AnalyzerContext(
+                    {Size(): DoubleMetric(Entity.DATASET, "Size", "*", Success(float(ts)))}
+                ),
+            )
+        for ts in (3, 4):
+            repository.save(
+                ResultKey(ts, {"Region": "NA"}),
+                AnalyzerContext(
+                    {Size(): DoubleMetric(Entity.DATASET, "Size", "*", Success(float(ts)))}
+                ),
+            )
+        return repository
+
+    def test_multiple_anomaly_checks_with_configs(self):
+        repository = self._repository_with_history()
+        df = _df_with_n_rows(11)
+        result = (
+            VerificationSuite.on_data(df)
+            .use_repository(repository)
+            .add_required_analyzers([Completeness("item")])
+            .save_or_append_result(ResultKey(5, {}))
+            .add_anomaly_check(
+                AbsoluteChangeStrategy(-2.0, 2.0),
+                Size(),
+                AnomalyCheckConfig(CheckLevel.WARNING, "Anomaly check to fail"),
+            )
+            .add_anomaly_check(
+                AbsoluteChangeStrategy(-7.0, 7.0),
+                Size(),
+                AnomalyCheckConfig(
+                    CheckLevel.ERROR, "Anomaly check to succeed", {}, 0, 11
+                ),
+            )
+            .add_anomaly_check(AbsoluteChangeStrategy(-7.0, 7.0), Size())
+            .run()
+        )
+        statuses = [cr.status for cr in result.check_results.values()]
+        # size jumped 4 -> 11: |7| > 2 trips the first check (WARNING level),
+        # |7| <= 7 passes the other two
+        assert statuses[0] == CheckStatus.WARNING
+        assert statuses[1] == CheckStatus.SUCCESS
+        assert statuses[2] == CheckStatus.SUCCESS
+
+
+class TestStatePersistence:
+    def test_state_persister_called_and_states_aggregatable(self, df_numeric):
+        """(reference `:316-360`) — saveStatesWith captures mergeable
+        states; aggregateWith folds them into a later run (doubling sums
+        when the same data is seen twice)."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        provider = InMemoryStateProvider()
+        analyzers = [Sum("att2"), Completeness("att1")]
+        (
+            VerificationSuite.on_data(df_numeric)
+            .add_required_analyzers(analyzers)
+            .save_states_with(provider)
+            .run()
+        )
+        assert provider.load(Sum("att2")) is not None
+        result = (
+            VerificationSuite.on_data(df_numeric)
+            .add_required_analyzers(analyzers)
+            .aggregate_with(provider)
+            .run()
+        )
+        assert result.metrics[Sum("att2")].value.get() == 18.0 * 2
+        assert result.metrics[Completeness("att1")].value.get() == 1.0
+
+
+class TestConstraintOrdering:
+    def test_constraint_results_keep_declaration_order(self, df_numeric):
+        """(reference `:362-392`)."""
+        from deequ_tpu.constraints import completeness_constraint, compliance_constraint
+
+        expected = [
+            completeness_constraint("att1", lambda v: v == 1.0),
+            compliance_constraint("att1 is positive", "att1 > 0", lambda v: v == 1.0),
+        ]
+        check = Check(CheckLevel.ERROR, "check")
+        for c in expected:
+            check = check.add_constraint(c)
+        assert list(check.constraints) == expected
+
+        result = VerificationSuite.on_data(df_numeric).add_check(check).run()
+        pairs = list(
+            zip(check.constraints, result.check_results[check].constraint_results, strict=True)
+        )
+        assert len(pairs) == len(expected)
+        for declared, evaluated in pairs:
+            assert declared == evaluated.constraint
