@@ -283,9 +283,10 @@ class HostBatchContext:
         return self.row_mask(analyzer) & self.batch.column(column).mask
 
     def block_stats(self, analyzer, column: str) -> np.ndarray:
-        """[count, sum, min, max, m2] over the analyzer-masked column — ONE
-        native pass shared by Mean/Sum/Min/Max/StdDev on the same column
-        (the host-tier analog of their fused device updates)."""
+        """[count, sum, min, max, m2, nonnan, max_nonnan] over the
+        analyzer-masked column — ONE native pass shared by
+        Mean/Sum/Min/Max/StdDev (and the KLL sampler's counting half) on the
+        same column (the host-tier analog of their fused device updates)."""
         where = getattr(analyzer, "where", None)
         key = ("stats", column, None if where is None else str(where))
         cached = self._pred_cache.get(key)
@@ -302,7 +303,7 @@ class HostBatchContext:
             else:
                 v = vals[mask].astype(np.float64)
                 if v.size == 0:
-                    cached = np.array([0.0, 0.0, np.nan, np.nan, 0.0])
+                    cached = np.array([0.0, 0.0, np.nan, np.nan, 0.0, 0.0, np.nan])
                 else:
                     # NaN-largest order, matching the native kernel and the
                     # device update: NaN never wins the min (no non-NaN
@@ -310,11 +311,23 @@ class HostBatchContext:
                     nonnan = v[~np.isnan(v)]
                     mn = nonnan.min() if nonnan.size else np.nan
                     mx = np.nan if nonnan.size < v.size else v.max()
+                    mx_nonnan = nonnan.max() if nonnan.size else np.nan
                     cached = np.array(
-                        [v.size, v.sum(), mn, mx, ((v - v.mean()) ** 2).sum()]
+                        [v.size, v.sum(), mn, mx, ((v - v.mean()) ** 2).sum(),
+                         float(nonnan.size), mx_nonnan]
                     )
             self._pred_cache[key] = cached
         return cached
+
+    def peek_block_stats(self, analyzer, column: str):
+        """The cached block_stats row, or None if no stats analyzer has
+        computed it for this (column, where) yet — lets the KLL sampler skip
+        its counting pass without forcing an extra stats pass when running
+        alone."""
+        where = getattr(analyzer, "where", None)
+        return self._pred_cache.get(
+            ("stats", column, None if where is None else str(where))
+        )
 
     def string_lengths(self, column: str) -> np.ndarray:
         key = ("len", column)

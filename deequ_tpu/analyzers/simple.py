@@ -348,7 +348,7 @@ class Mean(_NumericColumnAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> MeanState:
-        count, total, _mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        count, total = ctx.block_stats(self, self.column)[:2]
         return MeanState(_np_acc(total), _np_count(count))
 
     def update(self, state, features):
@@ -377,7 +377,7 @@ class Sum(_NumericColumnAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> SumState:
-        count, total, _mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        count, total = ctx.block_stats(self, self.column)[:2]
         return SumState(_np_acc(total), _np_count(count))
 
     def update(self, state, features):
@@ -406,7 +406,8 @@ class Minimum(_NumericColumnAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> MinState:
-        count, _s, mn, _mx, _m2 = ctx.block_stats(self, self.column)
+        stats = ctx.block_stats(self, self.column)
+        count, mn = stats[0], stats[2]
         # block_stats reports the NaN-largest min: NaN when the block holds
         # no non-NaN valid value — exactly MinState's identity
         return MinState(_np_acc(mn), _np_count(count))
@@ -440,7 +441,8 @@ class Maximum(_NumericColumnAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> MaxState:
-        count, _s, _mn, mx, _m2 = ctx.block_stats(self, self.column)
+        stats = ctx.block_stats(self, self.column)
+        count, mx = stats[0], stats[3]
         return MaxState(_np_acc(mx if count > 0 else -np.inf), _np_count(count))
 
     def update(self, state, features):
@@ -560,7 +562,8 @@ class StandardDeviation(_NumericColumnAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> StandardDeviationState:
-        count, total, _mn, _mx, m2 = ctx.block_stats(self, self.column)
+        stats = ctx.block_stats(self, self.column)
+        count, total, m2 = stats[0], stats[1], stats[4]
         avg = total / count if count > 0 else 0.0
         return StandardDeviationState(
             _np_acc(count), _np_acc(avg), _np_acc(m2 if count > 0 else 0.0)
@@ -729,7 +732,10 @@ class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
     def host_partial(self, ctx) -> DataTypeHistogram:
         codes = ctx.type_codes(self.column)
         mask = ctx.row_mask(self)
-        counts = np.bincount(codes[mask], minlength=5).astype(COUNT_DTYPE)
+        # all-true masks (no where-filter, unpadded host batches) skip the
+        # fancy-index copy of the codes array
+        masked = codes if mask.all() else codes[mask]
+        counts = np.bincount(masked, minlength=5)[:5].astype(COUNT_DTYPE)
         return DataTypeHistogram(counts)
 
     def update(self, state, features):

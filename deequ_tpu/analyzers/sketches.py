@@ -210,13 +210,28 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
 
     def host_partial(self, ctx):
         from ..config import ACC_DTYPE, COUNT_DTYPE
-        from ..native import native_block_kll_sample
+        from ..native import native_block_kll_pick, native_block_kll_sample
 
         col = ctx.batch.column(self.column)
         mask = ctx.column_mask(self, self.column)
         vals = col.values if np.issubdtype(col.values.dtype, np.number) else col.numeric_f64()
         k = self._sketch_size()
-        if native_block_kll_sample is not None:
+        stats = ctx.peek_block_stats(self, self.column)
+        if stats is not None and native_block_kll_pick is not None:
+            # a stats analyzer on the same column+mask already counted the
+            # non-NaN values and found min/max: skip the sampler's counting
+            # sweep (one less pass over the column's memory)
+            nv = int(stats[5])
+            if nv == 0:
+                items, m, h, mn, mx = (
+                    np.full(k, np.inf), 0, 0, np.inf, -np.inf
+                )
+            else:
+                items, m, h = native_block_kll_pick(
+                    vals, mask, k, ctx.batch_index, nv
+                )
+                mn, mx = float(stats[2]), float(stats[6])
+        elif native_block_kll_sample is not None:
             items, m, h, nv, mn, mx = native_block_kll_sample(
                 vals, mask, k, ctx.batch_index
             )
@@ -240,6 +255,7 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
 
 def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
     """numpy fallback for native block_kll_sample (same sampler semantics)."""
+    k = max(int(k), 1)  # non-positive sketch size must not hang the stride loop
     v = np.asarray(values, dtype=np.float64)
     ok = np.asarray(mask, dtype=bool) & ~np.isnan(v)
     vv = v[ok]
@@ -288,6 +304,10 @@ class KLLSketch(_KLLBackedAnalyzer):
                 raise IllegalAnalyzerParameterException(
                     f"Cannot return KLL Sketch related values for more than "
                     f"{MAXIMUM_ALLOWED_DETAIL_BINS} values"
+                )
+            if self.params.sketch_size < 1:
+                raise IllegalAnalyzerParameterException(
+                    f"KLL sketch size must be positive, got {self.params.sketch_size}"
                 )
 
         return [param_check] + super().preconditions()

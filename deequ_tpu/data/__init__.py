@@ -323,7 +323,13 @@ class Dataset:
 
     def _materialize_column(self, name: str, chunk: "pa.ChunkedArray") -> Column:
         kind = self._schema[name].kind
-        arr = chunk.combine_chunks() if isinstance(chunk, pa.ChunkedArray) else chunk
+        if isinstance(chunk, pa.ChunkedArray):
+            # single-chunk slices (the common case: one-chunk tables) pass
+            # through zero-copy; combine_chunks would COPY the slice — a
+            # full extra memory pass per column per batch
+            arr = chunk.chunk(0) if chunk.num_chunks == 1 else chunk.combine_chunks()
+        else:
+            arr = chunk
         n = len(arr)
         if arr.null_count:
             mask = np.asarray(arr.is_valid())

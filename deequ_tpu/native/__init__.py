@@ -23,12 +23,14 @@ native_block_comoments = None
 native_block_hll = None
 native_block_hll_strings = None
 native_block_kll_sample = None
+native_block_kll_pick = None
 
 try:  # pragma: no cover - exercised when the native lib is built
     from .lib import (  # noqa: F401
         native_block_comoments,
         native_block_hll,
         native_block_hll_strings,
+        native_block_kll_pick,
         native_block_kll_sample,
         native_block_stats,
         native_classify_types,

@@ -49,6 +49,10 @@ _lib.block_kll_sample_f64.argtypes = [
     _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
     _f64p, _i64p, _f64p,
 ]
+_lib.block_kll_pick_f64.argtypes = [
+    _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
+    ctypes.c_int64, _f64p, _i64p,
+]
 
 
 def _arrow_layout(values):
@@ -164,7 +168,9 @@ def _mask_u8(mask):
 
 
 def native_block_stats(values: np.ndarray, mask) -> np.ndarray:
-    """One C pass -> [count, sum, min, max, m2] over the masked block."""
+    """One C pass -> [count, sum, min, max, m2, nonnan, max_nonnan] over the
+    masked block (min/max follow the NaN-largest order; slots 5-6 let the
+    KLL sampler skip its counting pass)."""
     entry = _BLOCK_STATS.get(values.dtype)
     if entry is None:
         values = np.ascontiguousarray(values, dtype=np.float64)
@@ -172,10 +178,29 @@ def native_block_stats(values: np.ndarray, mask) -> np.ndarray:
     else:
         values = np.ascontiguousarray(values)
     name, vp = entry
-    out = np.empty(5, dtype=np.float64)
+    out = np.empty(7, dtype=np.float64)
     _m, mp = _mask_u8(mask)
     getattr(_lib, name)(_ptr(values, vp), mp, len(values), _ptr(out, _f64p))
     return out
+
+
+def native_block_kll_pick(values: np.ndarray, mask, k: int, tick: int, nv: int):
+    """(items f64[k] sorted asc with +inf padding, m, h) — the pick-only KLL
+    sampler for callers that already know the non-NaN valid count ``nv``
+    from a shared block_stats pass (one less memory sweep)."""
+    k = max(int(k), 1)  # keep the buffer in step with the kernel's k clamp
+    vals = np.ascontiguousarray(values, dtype=np.float64)
+    items = np.full(k, np.inf, dtype=np.float64)
+    meta = np.zeros(2, dtype=np.int64)
+    _m, mp = _mask_u8(mask)
+    _lib.block_kll_pick_f64(
+        _ptr(vals, _f64p), mp, len(vals), ctypes.c_int32(k),
+        ctypes.c_uint32(tick & 0xFFFFFFFF), ctypes.c_int64(nv),
+        _ptr(items, _f64p), _ptr(meta, _i64p),
+    )
+    m = int(meta[0])
+    items[m:] = np.inf
+    return items, m, int(meta[1])
 
 
 def native_block_comoments(x: np.ndarray, y: np.ndarray, mask) -> np.ndarray:
@@ -219,6 +244,7 @@ def native_block_hll_strings(values: np.ndarray, mask, seed: int,
 
 def native_block_kll_sample(values: np.ndarray, mask, k: int, tick: int):
     """(items f64[k] sorted asc with +inf padding, m, h, nv, min, max)."""
+    k = max(int(k), 1)  # keep the buffer in step with the kernel's k clamp
     vals = np.ascontiguousarray(values, dtype=np.float64)
     items = np.full(k, np.inf, dtype=np.float64)
     meta = np.zeros(3, dtype=np.int64)

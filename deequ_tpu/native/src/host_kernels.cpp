@@ -348,6 +348,8 @@ void string_lengths_batch(const uint8_t* data, const int64_t* offsets,
     out[2] = nonnan > 0 ? mn : qnan;                                         \
     out[3] = nans > 0 ? qnan : (nonnan > 0 ? mx : qnan);                     \
     out[4] = m2;                                                             \
+    out[5] = (double)nonnan;                                                 \
+    out[6] = nonnan > 0 ? mx : qnan; /* NaN-excluded max (KLL g_max) */      \
   }
 
 BLOCK_STATS_IMPL(block_stats_f64, double)
@@ -481,6 +483,33 @@ static int cmp_f64(const void* a, const void* b) {
   return (x > y) - (x < y);
 }
 
+// KLL pick-only variant: the caller already knows the valid (non-NaN) value
+// count from a shared block_stats pass over the same column+mask, so the
+// counting pass is skipped — one less memory sweep per column per batch.
+void block_kll_pick_f64(const double* v, const uint8_t* m, int64_t n,
+                        int32_t k, uint32_t tick, int64_t nv, double* items,
+                        int64_t* out_meta) {
+  if (k < 1) k = 1;  // a non-positive sketch size must not hang the loop
+  int64_t h = 0;
+  int64_t stride = 1;
+  while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
+  uint32_t r = ((tick * 2654435761u) ^ ((uint32_t)nv * 2246822519u)) >> 7;
+  int64_t offset = (int64_t)(r % (uint32_t)stride);
+  int64_t taken = 0, seen = 0;
+  for (int64_t i = 0; i < n && taken < k; ++i) {
+    if (m != nullptr && !m[i]) continue;
+    double x = v[i];
+    if (x != x) continue;
+    if ((seen - offset) >= 0 && (seen - offset) % stride == 0) {
+      items[taken++] = x;
+    }
+    ++seen;
+  }
+  qsort(items, (size_t)taken, sizeof(double), cmp_f64);
+  out_meta[0] = taken;
+  out_meta[1] = h;
+}
+
 void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
                           int32_t k, uint32_t tick, double* items,
                           int64_t* out_meta, double* out_minmax) {
@@ -523,6 +552,7 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
     mx = mx_l[j] > mx ? mx_l[j] : mx;
   }
   if (nv == 0) { mn = 0.0; mx = 0.0; }
+  if (k < 1) k = 1;  // a non-positive sketch size must not hang the loop
   int64_t h = 0;
   int64_t stride = 1;
   while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }

@@ -123,3 +123,36 @@ class TestHLLInternals:
 
     def test_estimate_zero(self):
         assert hll.estimate_cardinality(np.zeros(hll.M, dtype=np.int32)) == 0.0
+
+
+class TestKLLParameterValidation:
+    def test_non_positive_sketch_size_is_failure_metric_not_hang(self):
+        """A sketch_size of 0 must become a precondition failure metric, and
+        the native sampler guards the stride loop regardless (regression: an
+        unguarded k<=0 loop hung the process in native code)."""
+        import numpy as np
+
+        from deequ_tpu.analyzers import KLLParameters, KLLSketch
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.exceptions import IllegalAnalyzerParameterException
+        from deequ_tpu.runners import AnalysisRunner
+
+        data = Dataset.from_dict({"x": np.arange(100.0)})
+        a = KLLSketch("x", KLLParameters(sketch_size=0))
+        ctx = AnalysisRunner.do_analysis_run(data, [a])
+        value = ctx.metric(a).value
+        assert value.is_failure
+        assert isinstance(value.exception, IllegalAnalyzerParameterException)
+
+    def test_native_kernels_guard_non_positive_k(self):
+        import numpy as np
+
+        from deequ_tpu.native import native_block_kll_pick, native_block_kll_sample
+
+        if native_block_kll_sample is None:
+            return
+        v = np.arange(1000.0)
+        items, m, h, nv, mn, mx = native_block_kll_sample(v, None, 0, 0)
+        assert nv == 1000 and m <= 1
+        items, m, h = native_block_kll_pick(v, None, 0, 0, 1000)
+        assert m <= 1
