@@ -176,7 +176,12 @@ class ColumnProfiler:
             sharding=sharding,
         )
 
-        # ---- PASS 1: generic statistics (reference `:122-139`) ----
+        # ---- PASS 1: generic statistics (reference `:122-139`) PLUS the
+        # numeric statistics of columns the SCHEMA already types as numeric:
+        # those don't depend on pass-1 type inference, so they share the
+        # first scan (the reference always defers them to pass 2,
+        # `ColumnProfiler.scala:153-171` — for an all-native-typed table this
+        # build profiles in ONE data pass plus the low-card histogram scan)
         if print_status_updates:
             print("### PROFILING: Computing generic column statistics in pass (1/2)...")
         first_pass: List[Any] = [Size()]
@@ -185,6 +190,11 @@ class ColumnProfiler:
             first_pass.append(ApproxCountDistinct(name))
             if schema[name].kind == ColumnKind.STRING and name not in predefined_types:
                 first_pass.append(DataType(name))
+            elif schema[name].kind.is_numeric and predefined_types.get(
+                name, INTEGRAL
+            ) in (INTEGRAL, FRACTIONAL):
+                # skipped when the user predefines the column as non-numeric
+                first_pass += _numeric_analyzers(name, kll_parameters)
         first_results = AnalysisRunner.do_analysis_run(data, first_pass, **run_kwargs)
 
         generic = _extract_generic_statistics(
@@ -202,12 +212,12 @@ class ColumnProfiler:
         casted, casted_names = _cast_numeric_string_columns(relevant, data, generic)
         second_pass: List[Any] = []
         for name in relevant:
-            if generic.type_of(name) in (INTEGRAL, FRACTIONAL):
-                second_pass += [
-                    Minimum(name), Maximum(name), Mean(name),
-                    StandardDeviation(name), Sum(name),
-                    KLLSketch(name, kll_parameters),
-                ]
+            if generic.type_of(name) in (INTEGRAL, FRACTIONAL) and not schema[
+                name
+            ].kind.is_numeric:
+                # only inference-detected (casted string) columns remain;
+                # schema-typed numerics already ran in pass 1
+                second_pass += _numeric_analyzers(name, kll_parameters)
         histogram_columns = _find_target_columns_for_histograms(
             schema, generic, low_cardinality_histogram_threshold
         )
@@ -230,7 +240,7 @@ class ColumnProfiler:
             else None
         )
 
-        numeric_stats = _extract_numeric_statistics(second_results)
+        numeric_stats = _extract_numeric_statistics(first_results, second_results)
         histograms: Dict[str, Distribution] = {}
         for results in (second_results, third_results):
             if results is None:
@@ -341,11 +351,26 @@ class _NumericColumnStatistics:
     approx_percentiles: Dict[str, List[float]] = field(default_factory=dict)
 
 
-def _extract_numeric_statistics(results) -> _NumericColumnStatistics:
-    """(reference `ColumnProfiler.scala:440-520`)."""
+def _numeric_analyzers(name: str, kll_parameters: Optional[KLLParameters]) -> List[Any]:
+    return [
+        Minimum(name), Maximum(name), Mean(name),
+        StandardDeviation(name), Sum(name),
+        KLLSketch(name, kll_parameters),
+    ]
+
+
+def _extract_numeric_statistics(*result_sets) -> _NumericColumnStatistics:
+    """(reference `ColumnProfiler.scala:440-520`). Accepts several analyzer
+    contexts (pass 1 carries the schema-typed numeric columns, pass 2 the
+    casted ones) and merges them."""
     stats = _NumericColumnStatistics()
-    if results is None:
-        return stats
+    for results in result_sets:
+        if results is not None:
+            _fold_numeric_statistics(stats, results)
+    return stats
+
+
+def _fold_numeric_statistics(stats: _NumericColumnStatistics, results) -> None:
     for analyzer, metric in results.metric_map.items():
         if not metric.value.is_success:
             continue
@@ -363,7 +388,6 @@ def _extract_numeric_statistics(results) -> _NumericColumnStatistics:
             dist = metric.value.get()
             stats.kll[analyzer.column] = dist
             stats.approx_percentiles[analyzer.column] = sorted(dist.compute_percentiles())
-    return stats
 
 
 def _create_profiles(columns, generic, numeric_stats, histograms) -> ColumnProfiles:

@@ -202,3 +202,58 @@ class TestDetermineType:
         assert determine_type(self._dist(Boolean=3, Unknown=1)) == "Boolean"
         assert determine_type(self._dist(Fractional=1, Integral=5)) == "Fractional"
         assert determine_type(self._dist(Integral=5, Unknown=2)) == "Integral"
+
+
+class TestProfilerPassCounts:
+    """Schema-typed numeric columns profile in the FIRST scan (the reference
+    needs its pass 2, `ColumnProfiler.scala:153-171`); pass 2 only runs for
+    inference-casted string columns, pass 3 only for histogram targets."""
+
+    def test_native_numeric_high_cardinality_profiles_in_one_pass(self):
+        import numpy as np
+
+        from deequ_tpu.profiles import ColumnProfilerRunner, NumericColumnProfile
+        from deequ_tpu.runners.engine import RunMonitor
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=5000)
+        data = Dataset.from_dict({"a": a, "b": rng.integers(0, 10**9, 5000)})
+        mon = RunMonitor()
+        result = ColumnProfilerRunner.on_data(data).with_monitor(mon).run()
+        assert mon.passes == 1, mon.passes
+        profile = result.profiles["a"]
+        assert isinstance(profile, NumericColumnProfile)
+        assert profile.mean == pytest.approx(float(a.mean()), rel=1e-9)
+        assert profile.kll is not None
+
+    def test_low_cardinality_strings_add_histogram_pass(self):
+        import numpy as np
+
+        from deequ_tpu.profiles import ColumnProfilerRunner
+        from deequ_tpu.runners.engine import RunMonitor
+
+        rng = np.random.default_rng(1)
+        data = Dataset.from_dict(
+            {
+                "n": rng.normal(size=2000),
+                "c": [f"c{int(v)}" for v in rng.integers(0, 5, 2000)],
+            }
+        )
+        mon = RunMonitor()
+        result = ColumnProfilerRunner.on_data(data).with_monitor(mon).run()
+        assert mon.passes == 2, mon.passes  # pass 1 + histogram pass; no cast pass
+        assert result.profiles["c"].histogram is not None
+
+    def test_casted_string_column_still_two_data_passes(self):
+        from deequ_tpu.profiles import ColumnProfilerRunner, NumericColumnProfile
+        from deequ_tpu.runners.engine import RunMonitor
+
+        data = Dataset.from_dict(
+            {"t": [f"{i}.5" for i in range(200)]}  # numeric-looking strings
+        )
+        mon = RunMonitor()
+        result = ColumnProfilerRunner.on_data(data).with_monitor(mon).run()
+        profile = result.profiles["t"]
+        assert isinstance(profile, NumericColumnProfile)
+        assert profile.mean == pytest.approx(sum(i + 0.5 for i in range(200)) / 200)
+        assert mon.passes >= 2  # inference pass + casted numeric pass
