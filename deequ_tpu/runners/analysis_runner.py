@@ -239,12 +239,13 @@ class AnalysisRunner:
             else:
                 failures[a] = a.to_failure_metric(exc)
 
+        from ..analyzers.base import merge_states_batched
+
         metrics: Dict[Analyzer, Metric] = {}
         for a in passed:
-            merged = None
-            for loader in state_loaders:
-                loaded = loader.load(a)
-                merged = a.merge_states(merged, loaded)
+            merged = merge_states_batched(
+                a, [loader.load(a) for loader in state_loaders]
+            )
             if save_states_with is not None and merged is not None:
                 save_states_with.persist(a, merged)
             try:
@@ -264,10 +265,12 @@ def _finalize(
     aggregate_with: Optional[StateLoader],
     save_states_with: Optional[StatePersister],
 ) -> Metric:
+    from ..analyzers.base import merge_states_batched
+
     try:
         if aggregate_with is not None:
             loaded = aggregate_with.load(analyzer)
-            state = analyzer.merge_states(loaded, state)
+            state = merge_states_batched(analyzer, [loaded, state])
         if save_states_with is not None and state is not None:
             save_states_with.persist(analyzer, state)
         return analyzer.compute_metric_from(state)
