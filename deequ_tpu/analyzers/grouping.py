@@ -163,16 +163,24 @@ class FrequenciesAndNumRows:
         Mutates and returns self — per-batch copies of a potentially huge
         table are exactly the cost this accumulator exists to avoid."""
         mask = batch.row_mask
-        cols = {}
-        for name in self.group_columns:
-            col = batch.column(name)
-            mask = mask & col.mask
-            cols[name] = col.values
+        columns = {name: batch.column(name) for name in self.group_columns}
+        for col in columns.values():
+            mask = mask & col.mask  # validity masks only: values stay lazy
         self.num_rows += batch.num_rows
         if not mask.any():
             return self
         if len(self.group_columns) == 1:
-            vals = cols[self.group_columns[0]]
+            col = next(iter(columns.values()))
+            if col.arrow is not None and batch.row_mask.all():
+                # string keys kept as an Arrow array (its nulls ARE the
+                # validity mask): C-speed value_counts, no python-object
+                # materialization — touching col.values here would defeat it;
+                # the null group is excluded inside _arrow_value_counts
+                counts = _arrow_value_counts(col.arrow)
+                if counts is not None:
+                    self._append_run(counts)
+                    return self
+            vals = col.values
             if vals.dtype != object and np.issubdtype(vals.dtype, np.integer):
                 # integer keys: np.unique sorts + counts ~6x faster than a
                 # pandas groupby (floats stay on the groupby path — NaN
@@ -180,7 +188,7 @@ class FrequenciesAndNumRows:
                 uniques, cnts = np.unique(vals[mask], return_counts=True)
                 self._append_run(pd.Series(cnts.astype(np.int64), index=uniques))
                 return self
-        frame = pd.DataFrame({n: v[mask] for n, v in cols.items()})
+        frame = pd.DataFrame({n: c.values[mask] for n, c in columns.items()})
         counts = frame.groupby(self.group_columns, sort=False, dropna=False).size()
         if len(self.group_columns) == 1:
             counts.index = counts.index.get_level_values(0) if isinstance(
@@ -188,6 +196,34 @@ class FrequenciesAndNumRows:
             ) else counts.index
         self._append_run(counts)
         return self
+
+
+def _with_null_bin(counts: pd.Series, num_null: int) -> pd.Series:
+    """Add the NullValue bin (reference `analyzers/Histogram.scala:108`:
+    nulls count under the "NullValue" key) — the single definition all three
+    Histogram accumulation paths share."""
+    if not num_null:
+        return counts
+    return counts.add(
+        pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
+    ).astype(np.int64)
+
+
+def _arrow_value_counts(arr) -> Optional[pd.Series]:
+    """Distinct-value counts of an Arrow array as an int64 Series (null
+    entry dropped), or None when Arrow cannot count this type."""
+    import pyarrow.compute as pc
+
+    try:
+        vc = pc.value_counts(arr)
+    except Exception:  # noqa: BLE001 - unsupported type: caller falls back
+        return None
+    keys = vc.field("values").to_numpy(zero_copy_only=False)
+    counts = vc.field("counts").to_numpy(zero_copy_only=False)
+    keep = np.array([k is not None for k in keys], dtype=bool)
+    if not keep.all():
+        keys, counts = keys[keep], counts[keep]
+    return pd.Series(counts.astype(np.int64), index=keys)
 
 
 def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
@@ -525,6 +561,19 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
     def host_update(self, state: FrequenciesAndNumRows, batch: Batch) -> FrequenciesAndNumRows:
         col = batch.column(self.column)
         mask = batch.row_mask
+        if (
+            self.binning_func is None
+            and col.arrow is not None
+            and mask.all()
+        ):
+            # arrow-backed strings: count distincts C-speed without object
+            # materialization; string keys are their own Spark-string-cast
+            counts = _arrow_value_counts(col.arrow)
+            if counts is not None:
+                counts = _with_null_bin(counts, int(col.arrow.null_count))
+                state._append_run(counts)
+                state.num_rows += batch.num_rows
+                return state
         values = col.values[mask]
         present = col.mask[mask]
         if self.binning_func is None:
@@ -542,11 +591,7 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
                 cnts, index=[_spark_string_cast(k) for k in distinct], dtype=np.int64
             )
             counts = counts.groupby(level=0, sort=False).sum()
-            num_null = int(len(values) - present.sum())
-            if num_null:
-                counts = counts.add(
-                    pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
-                ).astype(np.int64)
+            counts = _with_null_bin(counts, int(len(values) - present.sum()))
         else:
             # bin the DISTINCT values, not every row: the binning function is
             # a pure value->bin mapping (the reference's binning UDF carries
@@ -570,11 +615,7 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
                 .groupby(level=0, sort=False)
                 .sum()
             )
-            num_null = int(len(values) - present.sum())
-            if num_null:
-                counts = counts.add(
-                    pd.Series({NULL_FIELD_REPLACEMENT: num_null}), fill_value=0
-                ).astype(np.int64)
+            counts = _with_null_bin(counts, int(len(values) - present.sum()))
         state._append_run(counts.astype(np.int64))
         state.num_rows += batch.num_rows
         return state
