@@ -218,10 +218,11 @@ def _arrow_value_counts(arr) -> Optional[pd.Series]:
         vc = pc.value_counts(arr)
     except Exception:  # noqa: BLE001 - unsupported type: caller falls back
         return None
-    keys = vc.field("values").to_numpy(zero_copy_only=False)
+    values = vc.field("values")
+    keys = values.to_numpy(zero_copy_only=False)
     counts = vc.field("counts").to_numpy(zero_copy_only=False)
-    keep = np.array([k is not None for k in keys], dtype=bool)
-    if not keep.all():
+    if values.null_count:
+        keep = np.asarray(pc.is_valid(values))
         keys, counts = keys[keep], counts[keep]
     return pd.Series(counts.astype(np.int64), index=keys)
 

@@ -202,3 +202,71 @@ class TestResultSerde:
                 assert got is not None and got.value.get() == metric.value.get(), a
         # the stored file is well-formed json
         json.loads((tmp_path / "metrics.json").read_text())
+
+
+class TestMergeAlgebraMatrix:
+    """Semigroup law for EVERY analyzer: states computed on disjoint
+    partitions and merged must yield the same metrics as one computation
+    over the union (the `StatesTest`/`IncrementalAnalyzerTest` analog, and
+    the correctness contract behind BASELINE config 4)."""
+
+    def test_three_way_partition_merge_equals_full_run(self, data):
+        thirds = []
+        n = data.num_rows
+        for i in range(3):
+            lo = i * n // 3
+            thirds.append(Dataset.from_arrow(data.arrow.slice(lo, (i + 1) * n // 3 - lo)))
+
+        providers = []
+        for part in thirds:
+            sp = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(part, ALL_ANALYZERS, save_states_with=sp)
+            providers.append(sp)
+
+        merged = AnalysisRunner.run_on_aggregated_states(
+            data.schema, ALL_ANALYZERS, providers
+        )
+        full = AnalysisRunner.do_analysis_run(data, ALL_ANALYZERS)
+        from deequ_tpu.metrics import Distribution
+
+        for a in ALL_ANALYZERS:
+            mv, fv = merged.metric(a).value, full.metric(a).value
+            assert mv.is_success == fv.is_success, a
+            if not mv.is_success:
+                continue
+            if a.name.startswith(("ApproxQuantile", "KLLSketch")):
+                continue  # sketch estimates vary across splits within bounds
+            got, want = mv.get(), fv.get()
+            if isinstance(want, float):
+                assert got == pytest.approx(want, rel=1e-9, abs=1e-12), a
+            elif isinstance(want, Distribution):
+                # exact distributions (DataType, Histogram) merge exactly
+                assert {k: v.absolute for k, v in got.values.items()} == {
+                    k: v.absolute for k, v in want.values.items()
+                }, a
+            else:
+                raise AssertionError(f"unchecked metric value type for {a}: {type(want)}")
+
+    def test_sketch_merges_stay_within_error_envelopes(self, data):
+        thirds = []
+        n = data.num_rows
+        for i in range(3):
+            lo = i * n // 3
+            thirds.append(Dataset.from_arrow(data.arrow.slice(lo, (i + 1) * n // 3 - lo)))
+        providers = []
+        battery = [ApproxCountDistinct("s"), ApproxQuantile("x", 0.5)]
+        for part in thirds:
+            sp = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(part, battery, save_states_with=sp)
+            providers.append(sp)
+        merged = AnalysisRunner.run_on_aggregated_states(data.schema, battery, providers)
+        # HLL merge is exact (register max): equals the full-run estimate
+        full = AnalysisRunner.do_analysis_run(data, battery)
+        assert merged.metric(ApproxCountDistinct("s")).value.get() == full.metric(
+            ApproxCountDistinct("s")
+        ).value.get()
+        # merged quantile stays within the rank-error envelope of the truth
+        xs = data.arrow["x"].drop_null().to_numpy()
+        med = merged.metric(ApproxQuantile("x", 0.5)).value.get()
+        rank_err = abs((xs <= med).mean() - 0.5)
+        assert rank_err < 0.02, (med, rank_err)
