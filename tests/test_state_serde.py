@@ -204,6 +204,14 @@ class TestResultSerde:
         json.loads((tmp_path / "metrics.json").read_text())
 
 
+def _thirds(data) -> list:
+    n = data.num_rows
+    return [
+        Dataset.from_arrow(data.arrow.slice(i * n // 3, (i + 1) * n // 3 - i * n // 3))
+        for i in range(3)
+    ]
+
+
 class TestMergeAlgebraMatrix:
     """Semigroup law for EVERY analyzer: states computed on disjoint
     partitions and merged must yield the same metrics as one computation
@@ -211,14 +219,8 @@ class TestMergeAlgebraMatrix:
     the correctness contract behind BASELINE config 4)."""
 
     def test_three_way_partition_merge_equals_full_run(self, data):
-        thirds = []
-        n = data.num_rows
-        for i in range(3):
-            lo = i * n // 3
-            thirds.append(Dataset.from_arrow(data.arrow.slice(lo, (i + 1) * n // 3 - lo)))
-
         providers = []
-        for part in thirds:
+        for part in _thirds(data):
             sp = InMemoryStateProvider()
             AnalysisRunner.do_analysis_run(part, ALL_ANALYZERS, save_states_with=sp)
             providers.append(sp)
@@ -248,14 +250,9 @@ class TestMergeAlgebraMatrix:
                 raise AssertionError(f"unchecked metric value type for {a}: {type(want)}")
 
     def test_sketch_merges_stay_within_error_envelopes(self, data):
-        thirds = []
-        n = data.num_rows
-        for i in range(3):
-            lo = i * n // 3
-            thirds.append(Dataset.from_arrow(data.arrow.slice(lo, (i + 1) * n // 3 - lo)))
         providers = []
         battery = [ApproxCountDistinct("s"), ApproxQuantile("x", 0.5)]
-        for part in thirds:
+        for part in _thirds(data):
             sp = InMemoryStateProvider()
             AnalysisRunner.do_analysis_run(part, battery, save_states_with=sp)
             providers.append(sp)
