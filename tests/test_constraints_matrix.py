@@ -1,0 +1,189 @@
+"""Constraint-factory matrix, ported from the reference's
+`ConstraintsTest.scala`: every factory evaluated directly against the
+canned fixtures with the reference's expected values/statuses."""
+
+import math
+
+import pytest
+
+from deequ_tpu import constraints as C
+from deequ_tpu.constraints import (
+    ASSERTION_EXCEPTION,
+    ConstraintDecorator,
+    ConstraintStatus,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+
+
+def calculate(constraint, data):
+    """Reference `ConstraintUtils.calculate`: run just the constraint's
+    analyzer, then evaluate the constraint against the metric map."""
+    inner = (
+        constraint.inner if isinstance(constraint, ConstraintDecorator) else constraint
+    )
+    ctx = AnalysisRunner.do_analysis_run(data, [inner.analyzer])
+    return constraint.evaluate(ctx.metric_map)
+
+
+@pytest.fixture
+def df_conditionally_uninformative():
+    """(reference `FixtureSupport.getDfWithConditionallyUninformativeColumns`)."""
+    return Dataset.from_dict({"att1": [1, 2, 3], "att2": [0, 0, 0]})
+
+
+class TestCompletenessConstraint:
+    def test_assert_on_wrong_completeness(self, df_missing):
+        # att1 is half present, att2 three quarters (reference `:32-43`)
+        assert calculate(
+            C.completeness_constraint("att1", lambda v: v == 0.5), df_missing
+        ).status == ConstraintStatus.SUCCESS
+        assert calculate(
+            C.completeness_constraint("att1", lambda v: v != 0.5), df_missing
+        ).status == ConstraintStatus.FAILURE
+        assert calculate(
+            C.completeness_constraint("att2", lambda v: v == 0.75), df_missing
+        ).status == ConstraintStatus.SUCCESS
+        assert calculate(
+            C.completeness_constraint("att2", lambda v: v != 0.75), df_missing
+        ).status == ConstraintStatus.FAILURE
+
+
+class TestHistogramConstraints:
+    def test_assert_on_bin_number(self, df_missing):
+        # att1 holds a, b and NullValue: 3 bins (reference `:46-52`)
+        assert calculate(
+            C.histogram_bin_constraint("att1", lambda v: v == 3), df_missing
+        ).status == ConstraintStatus.SUCCESS
+        assert calculate(
+            C.histogram_bin_constraint("att1", lambda v: v != 3), df_missing
+        ).status == ConstraintStatus.FAILURE
+
+    def test_missing_column_value_in_picker_is_assertion_failure(self, df_missing):
+        # the value picker indexes a bin that does not exist: structured
+        # assertion-exception message, not a crash (reference `:53-66`)
+        result = calculate(
+            C.histogram_constraint(
+                "att1", lambda dist: dist["non-existent-column-value"].ratio == 3
+            ),
+            df_missing,
+        )
+        assert result.status == ConstraintStatus.FAILURE
+        assert result.message is not None
+        assert ASSERTION_EXCEPTION in result.message
+
+
+class TestMutualInformationConstraint:
+    def test_conditionally_uninformative_columns_have_zero_mi(
+        self, df_conditionally_uninformative
+    ):
+        # att2 is constant: knowing att1 adds nothing (reference `:69-75`)
+        assert calculate(
+            C.mutual_information_constraint("att1", "att2", lambda v: v == 0),
+            df_conditionally_uninformative,
+        ).status == ConstraintStatus.SUCCESS
+
+
+class TestBasicStatsConstraints:
+    def test_approx_quantile(self, df_numeric):
+        assert calculate(
+            C.approx_quantile_constraint("att1", 0.5, lambda v: v == 3.0), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_minimum(self, df_numeric):
+        assert calculate(
+            C.min_constraint("att1", lambda v: v == 1.0), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_maximum(self, df_numeric):
+        assert calculate(
+            C.max_constraint("att1", lambda v: v == 6.0), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_mean(self, df_numeric):
+        assert calculate(
+            C.mean_constraint("att1", lambda v: v == 3.5), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_sum(self, df_numeric):
+        assert calculate(
+            C.sum_constraint("att1", lambda v: v == 21.0), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_standard_deviation(self, df_numeric):
+        # population stddev of 1..6
+        want = math.sqrt(sum((x - 3.5) ** 2 for x in range(1, 7)) / 6)
+        assert calculate(
+            C.standard_deviation_constraint(
+                "att1", lambda v: v == pytest.approx(want, rel=1e-12)
+            ),
+            df_numeric,
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_approx_count_distinct(self, df_numeric):
+        assert calculate(
+            C.approx_count_distinct_constraint("att1", lambda v: v == 6.0), df_numeric
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_correlation_of_distinct_columns(self, df_numeric):
+        # numpy oracle: corr(att2=[0,0,0,5,6,7], att3=[0,0,0,4,6,7])
+        want = 0.992763360363403
+        assert calculate(
+            C.correlation_constraint(
+                "att2", "att3", lambda v: v == pytest.approx(want, rel=1e-12)
+            ),
+            df_numeric,
+        ).status == ConstraintStatus.SUCCESS
+
+
+class TestUniquenessConstraints:
+    def test_uniqueness_of_unique_column(self, df_full):
+        assert calculate(
+            C.uniqueness_constraint(["item"], lambda v: v == 1.0), df_full
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_uniqueness_of_repeating_column(self, df_full):
+        # att1 = [a, b, a, a]: only b is unique -> 1/4
+        assert calculate(
+            C.uniqueness_constraint(["att1"], lambda v: v == 0.25), df_full
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_distinctness(self, df_full):
+        # att1 has 2 distinct groups over 4 rows
+        assert calculate(
+            C.distinctness_constraint(["att1"], lambda v: v == 0.5), df_full
+        ).status == ConstraintStatus.SUCCESS
+
+
+class TestComplianceAndPattern:
+    def test_compliance(self, df_numeric):
+        assert calculate(
+            C.compliance_constraint("att1 > 2", "att1 > 2", lambda v: v == pytest.approx(4 / 6)),
+            df_numeric,
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_pattern_match(self, df_full):
+        assert calculate(
+            C.pattern_match_constraint("att1", r"^[a-z]$", lambda v: v == 1.0), df_full
+        ).status == ConstraintStatus.SUCCESS
+
+    def test_data_type_ratio(self):
+        from deequ_tpu.constraints import ConstrainableDataTypes
+
+        data = Dataset.from_dict({"v": ["1", "2.0", "x", "true"]})
+        assert calculate(
+            C.data_type_constraint(
+                "v", ConstrainableDataTypes.NUMERIC, lambda v: v == 0.5
+            ),
+            data,
+        ).status == ConstraintStatus.SUCCESS
+
+
+class TestSizeConstraint:
+    def test_size(self, df_full):
+        assert calculate(
+            C.size_constraint(lambda v: v == 4), df_full
+        ).status == ConstraintStatus.SUCCESS
+        assert calculate(
+            C.size_constraint(lambda v: v > 4), df_full
+        ).status == ConstraintStatus.FAILURE
