@@ -1,0 +1,160 @@
+"""Anomaly detection over repository history, end to end — the
+`MetricsRepositoryAnomalyDetectionIntegrationTest.scala` analog: a month of
+simulated per-marketplace metric history, then a verification run whose
+anomaly checks filter that history by tag AND date window before judging
+the freshly computed metrics."""
+
+import datetime
+
+import pyarrow as pa
+import pytest
+
+from deequ_tpu import (
+    AnomalyCheckConfig,
+    Check,
+    CheckLevel,
+    CheckStatus,
+    DoubleMetric,
+    Entity,
+    InMemoryMetricsRepository,
+    ResultKey,
+    Success,
+    VerificationSuite,
+)
+from deequ_tpu.analyzers import Maximum, Mean, Minimum, Size
+from deequ_tpu.anomalydetection import AbsoluteChangeStrategy, OnlineNormalStrategy
+from deequ_tpu.data import Dataset
+from deequ_tpu.repository import FileSystemMetricsRepository
+from deequ_tpu.runners.context import AnalyzerContext
+
+
+def _date_ms(year: int, month: int, day: int) -> int:
+    return int(
+        datetime.datetime(year, month, day, tzinfo=datetime.timezone.utc).timestamp()
+        * 1000
+    )
+
+
+def _test_data() -> Dataset:
+    """(reference `getTestData`: 8 EU rows, sales mean 206.625)."""
+    rows = [
+        ("item1", "US", 100), ("item1", "US", 1000), ("item1", "US", 20),
+        ("item2", "DE", 20), ("item2", "DE", 333),
+        ("item3", None, 12), ("item4", None, 45), ("item5", None, 123),
+    ]
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "item": pa.array([r[0] for r in rows]),
+                "origin": pa.array([r[1] for r in rows]),
+                "sales": pa.array([r[2] for r in rows], type=pa.int64()),
+                "marketplace": pa.array(["EU"] * len(rows)),
+            }
+        )
+    )
+
+
+def _fill_repository_with_previous_results(repository) -> None:
+    """30 July-2018 days of Size/Mean history per marketplace (reference
+    `fillRepositoryWithPreviousResults`)."""
+    for past_day in range(1, 31):
+        eu = AnalyzerContext(
+            {
+                Size(): DoubleMetric(Entity.DATASET, "Size", "*", Success(past_day // 3 * 1.0)),
+                Mean("sales"): DoubleMetric(
+                    Entity.COLUMN, "Mean", "sales", Success(past_day * 7.0)
+                ),
+            }
+        )
+        na = AnalyzerContext(
+            {
+                Size(): DoubleMetric(Entity.DATASET, "Size", "*", Success(float(past_day))),
+                Mean("sales"): DoubleMetric(
+                    Entity.COLUMN, "Mean", "sales", Success(past_day * 9.0)
+                ),
+            }
+        )
+        when = _date_ms(2018, 7, past_day)
+        repository.save(ResultKey(when, {"marketplace": "EU"}), eu)
+        repository.save(ResultKey(when, {"marketplace": "NA"}), na)
+
+
+def _run_everything(repository):
+    data = _test_data()
+    check = (
+        Check(CheckLevel.ERROR, "check")
+        .is_complete("item")
+        .is_complete("origin")
+        .is_contained_in("marketplace", ["EU"])
+        .is_non_negative("sales")
+    )
+    filter_eu = {"marketplace": "EU"}
+    after = _date_ms(2018, 1, 1)
+    before = _date_ms(2018, 8, 1)
+    return (
+        VerificationSuite.on_data(data)
+        .add_check(check)
+        .add_required_analyzers([Maximum("sales"), Minimum("sales")])
+        .use_repository(repository)
+        # size must only increase: new size 8 < last EU size 10 -> anomaly
+        .add_anomaly_check(
+            AbsoluteChangeStrategy(0.0),
+            Size(),
+            AnomalyCheckConfig(
+                CheckLevel.ERROR, "Size only increases", filter_eu, after, before
+            ),
+        )
+        # mean sales 206.625 is within 2 stddev of the EU history (~111 +/- ~62)
+        .add_anomaly_check(
+            OnlineNormalStrategy(upper_deviation_factor=2.0, ignore_anomalies=False),
+            Mean("sales"),
+            AnomalyCheckConfig(
+                CheckLevel.WARNING,
+                "Sales mean within 2 standard deviations",
+                filter_eu,
+                after,
+                before,
+            ),
+        )
+        .save_or_append_result(ResultKey(_date_ms(2018, 8, 1), filter_eu))
+        .run()
+    )
+
+
+def _assert_results(result) -> None:
+    by_description = {
+        check.description: check_result
+        for check, check_result in result.check_results.items()
+    }
+    # the NA history (size up to 30, means *9) must NOT leak into the
+    # EU-filtered checks: with it, size 8 would not be the anomaly judgement
+    # the reference pins
+    assert by_description["Size only increases"].status == CheckStatus.ERROR
+    assert (
+        by_description["Sales mean within 2 standard deviations"].status
+        == CheckStatus.SUCCESS
+    )
+    assert by_description["check"].status == CheckStatus.ERROR  # origin has nulls
+
+
+class TestAnomalyDetectionOverRepositoryHistory:
+    def test_in_memory_repository(self):
+        repository = InMemoryMetricsRepository()
+        _fill_repository_with_previous_results(repository)
+        _assert_results(_run_everything(repository))
+
+    def test_filesystem_repository(self, tmp_path):
+        repository = FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
+        _fill_repository_with_previous_results(repository)
+        _assert_results(_run_everything(repository))
+
+    def test_new_result_lands_in_repository(self):
+        repository = InMemoryMetricsRepository()
+        _fill_repository_with_previous_results(repository)
+        _run_everything(repository)
+        saved = repository.load_by_key(
+            ResultKey(_date_ms(2018, 8, 1), {"marketplace": "EU"})
+        )
+        assert saved is not None
+        assert saved.metric(Size()).value.get() == 8.0
+        assert saved.metric(Mean("sales")).value.get() == pytest.approx(206.625)
