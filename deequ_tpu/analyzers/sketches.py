@@ -223,8 +223,9 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
             # sweep (one less pass over the column's memory)
             nv = int(stats[5])
             if nv == 0:
+                # identity partial — same (4k,) width as every sampler path
                 items, m, h, mn, mx = (
-                    np.full(k, np.inf), 0, 0, np.inf, -np.inf
+                    np.full(4 * k, np.inf), 0, 0, np.inf, -np.inf
                 )
             else:
                 items, m, h = native_block_kll_pick(
@@ -254,13 +255,15 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
 
 
 def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
-    """numpy fallback for native block_kll_sample (same sampler semantics)."""
+    """numpy fallback for native block_kll_sample (same sampler semantics,
+    incl. the up-to-two-levels-denser stride policy — compaction reduces the
+    extra items with deterministic error instead of sampling variance)."""
     k = max(int(k), 1)  # non-positive sketch size must not hang the stride loop
     v = np.asarray(values, dtype=np.float64)
     ok = np.asarray(mask, dtype=bool) & ~np.isnan(v)
     vv = v[ok]
     nv = int(vv.size)
-    items = np.full(k, np.inf, dtype=np.float64)
+    items = np.full(4 * k, np.inf, dtype=np.float64)
     if nv == 0:
         return items, 0, 0, 0, np.inf, -np.inf
     h = 0
@@ -268,6 +271,10 @@ def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
     while stride * k < nv:
         stride <<= 1
         h += 1
+    dense = 2 if h >= 2 else h
+    h -= dense
+    stride >>= dense
+    cap = k << dense
     # batch index XOR valid-count mixing, bit-identical to the native
     # block_kll_sample_f64 (periodic streams must not phase-lock the stride)
     r = (
@@ -275,7 +282,14 @@ def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
         ^ (np.uint32(nv) * np.uint32(2246822519))
     ) >> np.uint32(7)
     offset = int(r % np.uint32(stride))
-    picked = np.sort(vv[offset::stride])[:k]
+    picked = np.sort(vv[offset::stride])[:cap]
+    if dense == 2 and picked.size > 1:
+        # one in-sampler compaction: every 2nd of the sorted dense pick,
+        # weight doubles — keeps the dense sample's rank accuracy while
+        # emitting <= 2k items (the state-buffer occupancy bound)
+        parity = int((r >> np.uint32(8)) & np.uint32(1))
+        picked = picked[parity::2]
+        h += 1
     items[: picked.size] = picked
     return items, int(picked.size), h, nv, float(vv.min()), float(vv.max())
 

@@ -190,7 +190,8 @@ def native_block_kll_pick(values: np.ndarray, mask, k: int, tick: int, nv: int):
     from a shared block_stats pass (one less memory sweep)."""
     k = max(int(k), 1)  # keep the buffer in step with the kernel's k clamp
     vals = np.ascontiguousarray(values, dtype=np.float64)
-    items = np.full(k, np.inf, dtype=np.float64)
+    # 4k wide: the kernel's stride policy picks up to two levels denser
+    items = np.full(4 * k, np.inf, dtype=np.float64)
     meta = np.zeros(2, dtype=np.int64)
     _m, mp = _mask_u8(mask)
     _lib.block_kll_pick_f64(
@@ -243,10 +244,12 @@ def native_block_hll_strings(values: np.ndarray, mask, seed: int,
 
 
 def native_block_kll_sample(values: np.ndarray, mask, k: int, tick: int):
-    """(items f64[k] sorted asc with +inf padding, m, h, nv, min, max)."""
+    """(items f64[4k] sorted asc with +inf padding beyond m, m, h, nv,
+    min, max) — m <= 2k after the in-kernel dense-pick compaction."""
     k = max(int(k), 1)  # keep the buffer in step with the kernel's k clamp
     vals = np.ascontiguousarray(values, dtype=np.float64)
-    items = np.full(k, np.inf, dtype=np.float64)
+    # 4k wide: the kernel's stride policy picks up to two levels denser
+    items = np.full(4 * k, np.inf, dtype=np.float64)
     meta = np.zeros(3, dtype=np.int64)
     minmax = np.zeros(2, dtype=np.float64)
     _m, mp = _mask_u8(mask)

@@ -486,17 +486,55 @@ static int cmp_f64(const void* a, const void* b) {
 // KLL pick-only variant: the caller already knows the valid (non-NaN) value
 // count from a shared block_stats pass over the same column+mask, so the
 // counting pass is skipped — one less memory sweep per column per batch.
+// Shared stride policy for the host samplers: pick up to TWO levels denser
+// than the stride that fits k items, then (when two levels denser) compact
+// the sorted sample once in-kernel — every 2nd item, parity from the batch
+// randomness — emitting <= 2k items one level up. The emitted items carry
+// the rank accuracy of the 4x-denser sample (compaction error is
+// deterministic and tiny vs sampling variance), which a plain k-item pick
+// lacks (~2x the rank error of the device path's sorted order statistics;
+// validated by the host-tier rank-error tests). The <= 2k emission also
+// preserves the state-buffer occupancy invariant: a level may hold up to k
+// uncompacted residuals, and 2k + k <= the 4k buffer.
+static inline void kll_stride_policy(int32_t k, int64_t nv, int64_t* out_h,
+                                     int64_t* out_stride, int64_t* out_cap,
+                                     int* out_dense) {
+  int64_t h = 0;
+  int64_t stride = 1;
+  while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
+  int dense = h >= 2 ? 2 : (int)h;
+  h -= dense;
+  stride >>= dense;
+  *out_h = h;
+  *out_stride = stride;
+  *out_cap = (int64_t)k << dense;
+  *out_dense = dense;
+}
+
+// In-place compaction of the sorted pick when it was two levels dense:
+// emit every 2nd item (parity from r), halving the count and raising the
+// weight one level. Returns the new item count; *h is incremented.
+static inline int64_t kll_compact_pick(double* items, int64_t taken,
+                                       int dense, uint32_t r, int64_t* h) {
+  if (dense < 2 || taken <= 1) return taken;
+  int64_t parity = (int64_t)((r >> 8) & 1u);
+  int64_t m_out = (taken - parity + 1) / 2;
+  for (int64_t j = 0; j < m_out; ++j) items[j] = items[parity + 2 * j];
+  *h += 1;
+  return m_out;
+}
+
 void block_kll_pick_f64(const double* v, const uint8_t* m, int64_t n,
                         int32_t k, uint32_t tick, int64_t nv, double* items,
                         int64_t* out_meta) {
   if (k < 1) k = 1;  // a non-positive sketch size must not hang the loop
-  int64_t h = 0;
-  int64_t stride = 1;
-  while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
+  int64_t h, stride, cap;
+  int dense;
+  kll_stride_policy(k, nv, &h, &stride, &cap, &dense);
   uint32_t r = ((tick * 2654435761u) ^ ((uint32_t)nv * 2246822519u)) >> 7;
   int64_t offset = (int64_t)(r % (uint32_t)stride);
   int64_t taken = 0, seen = 0;
-  for (int64_t i = 0; i < n && taken < k; ++i) {
+  for (int64_t i = 0; i < n && taken < cap; ++i) {
     if (m != nullptr && !m[i]) continue;
     double x = v[i];
     if (x != x) continue;
@@ -506,6 +544,7 @@ void block_kll_pick_f64(const double* v, const uint8_t* m, int64_t n,
     ++seen;
   }
   qsort(items, (size_t)taken, sizeof(double), cmp_f64);
+  taken = kll_compact_pick(items, taken, dense, r, &h);
   out_meta[0] = taken;
   out_meta[1] = h;
 }
@@ -553,9 +592,9 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
   }
   if (nv == 0) { mn = 0.0; mx = 0.0; }
   if (k < 1) k = 1;  // a non-positive sketch size must not hang the loop
-  int64_t h = 0;
-  int64_t stride = 1;
-  while (stride * (int64_t)k < nv) { stride <<= 1; ++h; }
+  int64_t h, stride, cap;
+  int dense;
+  kll_stride_policy(k, nv, &h, &stride, &cap, &dense);
   // offset mixes the batch index AND the valid-value count so a stream
   // whose structure is periodic in the batch size cannot stay phase-locked
   // with the sampler (must match _np_kll_sample in analyzers/sketches.py
@@ -563,7 +602,7 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
   uint32_t r = ((tick * 2654435761u) ^ ((uint32_t)nv * 2246822519u)) >> 7;
   int64_t offset = (int64_t)(r % (uint32_t)stride);
   int64_t taken = 0, seen = 0;
-  for (int64_t i = 0; i < n && taken < k; ++i) {
+  for (int64_t i = 0; i < n && taken < cap; ++i) {
     if (m != nullptr && !m[i]) continue;
     double x = v[i];
     if (x != x) continue;
@@ -573,6 +612,7 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
     ++seen;
   }
   qsort(items, (size_t)taken, sizeof(double), cmp_f64);
+  taken = kll_compact_pick(items, taken, dense, r, &h);
   out_meta[0] = taken;  // m
   out_meta[1] = h;
   out_meta[2] = nv;     // exact valid count

@@ -231,11 +231,12 @@ def kll_ingest_sampled(
     g_max: jnp.ndarray,
 ) -> KLLSketchState:
     """Fold a host-side pre-sampled block into the sketch: ``samples`` is a
-    sorted, +inf-padded (k,) vector of ``m`` items carrying weight ``2^h``
-    each, covering ``nv`` underlying values with the given block min/max
-    (the native ingest tier's `block_kll_sample` output — the bottom-sampler
-    form of kll_update's batch pre-collapse). Pure jax; runs inside the
-    jit'd partial-fold program."""
+    sorted, +inf-padded (<=4k,) vector of ``m`` items carrying weight
+    ``2^h`` each, covering ``nv`` underlying values with the given block
+    min/max (the native ingest tier's `block_kll_sample` output — the
+    bottom-sampler form of kll_update's batch pre-collapse, sampled up to
+    two levels denser than strictly fits so compaction absorbs the surplus).
+    Pure jax; runs inside the jit'd partial-fold program."""
     k = state.sketch_size
     # clamp like kll_update: legitimate huge/-inf values saturate to the
     # finite ITEM range (a -inf must stay minimum-side). Padding beyond the

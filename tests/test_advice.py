@@ -239,3 +239,22 @@ class TestPlacementRecording:
         assert mon.feed_bandwidth_mbps is not None
         assert mon.feed_bandwidth_mbps > 0
         assert mon.placement in ("host", "device")
+
+
+class TestHostTierQuantileAccuracy:
+    """The host bottom-sampler must honor ApproxQuantile's relative_error
+    like the device path does (regression: a plain k-item pick had ~2x the
+    rank error and broke the 1% envelope at the tails)."""
+
+    @pytest.mark.parametrize("q", [0.1, 0.5, 0.9])
+    def test_host_rank_error_within_envelope(self, q):
+        from deequ_tpu.analyzers import ApproxQuantile
+
+        rng = np.random.default_rng(9)
+        vals = rng.normal(100, 15, 100_000)
+        data = Dataset.from_dict({"col": vals})
+        a = ApproxQuantile("col", q, relative_error=0.01)
+        ctx = AnalysisRunner.do_analysis_run(data, [a], placement="host")
+        est = ctx.metric(a).value.get()
+        rank = (np.sort(vals) <= est).mean()
+        assert abs(rank - q) <= 0.01, (q, est, rank)

@@ -154,7 +154,8 @@ class TestKLLParameterValidation:
         if native_block_kll_sample is None:
             pytest.skip("native lib not built")
         v = np.arange(1000.0)
+        # k clamps to 1; the denser stride policy may pick up to 4*k items
         items, m, h, nv, mn, mx = native_block_kll_sample(v, None, 0, 0)
-        assert nv == 1000 and m <= 1
+        assert nv == 1000 and m <= 4
         items, m, h = native_block_kll_pick(v, None, 0, 0, 1000)
-        assert m <= 1
+        assert m <= 4
