@@ -267,14 +267,28 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
         return states[0]
     import jax
 
+    def _leaf_sig(leaf):
+        # metadata only — np.asarray here would force a blocking D2H copy of
+        # every leaf of every state before the fold even dispatches
+        return (getattr(leaf, "shape", ()), np.dtype(leaf.dtype))
+
     leaves, treedef = jax.tree_util.tree_flatten(states[0])
     array_like = bool(leaves) and all(
         hasattr(leaf, "dtype") and getattr(leaf, "dtype", None) != object
         for leaf in leaves
     )
     if array_like:
+        # States persisted under different layouts (e.g. KLL sketches saved
+        # before a capacity widening, or differing level counts) share a
+        # treedef but not leaf shapes; np.stack would raise mid-fold. Require
+        # identical leaf shapes AND dtypes, else fall back to the sequential
+        # analyzer.merge fold, which handles heterogeneous states.
+        sig = [_leaf_sig(leaf) for leaf in leaves]
         for s in states[1:]:
-            if jax.tree_util.tree_flatten(s)[1] != treedef:
+            other_leaves, other_treedef = jax.tree_util.tree_flatten(s)
+            if other_treedef != treedef or [
+                _leaf_sig(leaf) for leaf in other_leaves
+            ] != sig:
                 array_like = False
                 break
     if not array_like:

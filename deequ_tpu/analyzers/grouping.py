@@ -529,7 +529,16 @@ MAXIMUM_ALLOWED_DETAIL_BINS = 1000  # reference `analyzers/Histogram.scala:109`
 class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
     """Exact value histogram of one column: values cast to string, nulls
     replaced by "NullValue", optional binning function, top-K detail bins by
-    count (reference `analyzers/Histogram.scala:41-116`)."""
+    count (reference `analyzers/Histogram.scala:41-116`).
+
+    ``binning_func`` MUST be a pure ``value -> bin`` mapping: it is applied
+    once per DISTINCT value, not once per row (the engine counts raw values
+    first and bins each distinct key once, turning an O(rows) Python loop
+    into O(distinct)). A non-pure or row-position-dependent function would
+    silently produce different counts than per-row application; the
+    reference's binning UDF (`analyzers/Histogram.scala:63-66`) carries the
+    same value-determinism assumption. Returning ``None`` buckets the value
+    as "NullValue"."""
 
     column: str = ""
     binning_func: Optional[Callable] = None

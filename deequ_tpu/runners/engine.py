@@ -189,6 +189,18 @@ def _restore_kll_width(fetched: List[Any], widths: List[Optional[int]]) -> List[
             continue
         low_state, top = fetched[i]
         low = np.asarray(low_state.items)
+        # Losslessness of the slim rests on every non-top level holding
+        # <= sketch_size items at fetch time (guaranteed because every
+        # update/ingest/merge ends in _compact_cascade). A future code path
+        # fetching mid-append would otherwise silently truncate items; the
+        # shipped `sizes` let us fail loudly instead.
+        sizes = np.asarray(low_state.sizes)
+        if (sizes[:-1] > low_state.sketch_size).any():
+            raise AssertionError(
+                "KLL slim-for-fetch invariant violated: non-top level holds "
+                f"{int(sizes[:-1].max())} items > sketch_size "
+                f"{low_state.sketch_size}; state was fetched mid-append"
+            )
         pad = np.full((low.shape[0], width - low.shape[1]), np.inf, dtype=low.dtype)
         items = np.concatenate(
             [np.concatenate([low, pad], axis=1), np.asarray(top)], axis=0

@@ -258,3 +258,83 @@ class TestHostTierQuantileAccuracy:
         est = ctx.metric(a).value.get()
         rank = (np.sort(vals) <= est).mean()
         assert abs(rank - q) <= 0.01, (q, est, rank)
+
+
+class TestHeterogeneousStateMergeFallback:
+    """ADVICE r3: merge_states_batched must not np.stack states whose leaf
+    shapes differ (e.g. KLL sketches persisted before a capacity widening);
+    it must fall back to the sequential analyzer.merge fold."""
+
+    def test_mixed_width_kll_states_merge(self):
+        import jax.numpy as jnp
+
+        from deequ_tpu.analyzers.base import merge_states_batched
+        from deequ_tpu.ops.kll import kll_init, kll_merge, kll_update
+
+        rng = np.random.default_rng(3)
+        ones = jnp.ones(500, dtype=bool)
+        a = kll_update(kll_init(sketch_size=64), jnp.asarray(rng.normal(size=500)), ones)
+        b = kll_update(kll_init(sketch_size=64), jnp.asarray(rng.normal(size=500)), ones)
+        # simulate a state persisted under an older, narrower item-buffer
+        # layout: same treedef, different leaf shape
+        narrow = b.replace(items=jnp.asarray(np.asarray(b.items)[:, :128]))
+
+        class _KLLMergeOnly:
+            def merge(self, x, y):
+                return kll_merge(x, y)
+
+        merged = merge_states_batched(_KLLMergeOnly(), [a, narrow])
+        assert int(merged.count) == 1000
+
+    def test_homogeneous_states_still_batch(self):
+        from deequ_tpu.analyzers.base import merge_states_batched
+        from deequ_tpu.analyzers.states import MeanState
+
+        a = Mean("x")
+        states = [
+            MeanState(np.float64(float(i)), np.int64(1)) for i in range(4)
+        ]
+        merged = merge_states_batched(a, states)
+        assert a.compute_metric_from(merged).value.get() == pytest.approx(1.5)
+
+
+class TestKllSlimInvariantGuard:
+    """ADVICE r3: _restore_kll_width must fail loudly (not silently corrupt
+    quantiles) if a state was fetched mid-append with a non-top level
+    holding more than sketch_size items."""
+
+    def test_violation_raises(self):
+        from deequ_tpu.ops.kll import kll_init, kll_update
+        from deequ_tpu.runners.engine import _restore_kll_width, _slim_kll_for_fetch
+
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.random.default_rng(0).normal(size=4000))
+        s = kll_update(kll_init(sketch_size=32), vals, jnp.ones(4000, dtype=bool))
+        slim, widths = _slim_kll_for_fetch((s,))
+        assert widths[0] is not None
+        low, top = slim[0]
+        # forge a mid-append fetch: claim a non-top level holds > k items
+        bad_sizes = np.asarray(low.sizes).copy()
+        bad_sizes[0] = low.sketch_size + 5
+        forged = low.replace(sizes=jnp.asarray(bad_sizes))
+        with pytest.raises(AssertionError, match="mid-append"):
+            _restore_kll_width([(forged, np.asarray(top))], widths)
+
+    def test_normal_roundtrip_lossless(self):
+        import jax.numpy as jnp
+
+        from deequ_tpu.ops.kll import kll_init, kll_update
+        from deequ_tpu.ops.kll_host import HostKLL
+        from deequ_tpu.runners.engine import _restore_kll_width, _slim_kll_for_fetch
+
+        vals = jnp.asarray(np.random.default_rng(1).normal(size=4000))
+        s = kll_update(kll_init(sketch_size=32), vals, jnp.ones(4000, dtype=bool))
+        slim, widths = _slim_kll_for_fetch((s,))
+        low, top = slim[0]
+        restored = _restore_kll_width(
+            [(low, np.asarray(top))], widths
+        )[0]
+        assert np.asarray(restored.items).shape == np.asarray(s.items).shape
+        for q in (0.1, 0.5, 0.9):
+            assert HostKLL.from_state(restored).quantile(q) == HostKLL.from_state(s).quantile(q)
