@@ -509,13 +509,55 @@ def _as_tuple(columns) -> Tuple[str, ...]:
     return tuple(columns)
 
 
+def _java_double_to_string(x: float) -> str:
+    """Java ``Double.toString`` semantics: shortest round-trip digits,
+    plain decimal for 1e-3 <= |x| < 1e7, otherwise computerized scientific
+    notation ``d.dddEn`` (no '+', no leading exponent zeros). Spark's
+    cast-to-string on DoubleType delegates to this, so Histogram bin keys
+    and suggestion category lists must match it exactly (e.g. 1e7 keys as
+    '1.0E7', not '10000000.0')."""
+    import math
+
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0.0:
+        return "-0.0" if math.copysign(1.0, x) < 0 else "0.0"
+    sign = "-" if x < 0 else ""
+    a = abs(x)
+    if 1e-3 <= a < 1e7:
+        # Python repr is also shortest-round-trip and stays in plain
+        # decimal over exactly this range (it switches to sci only below
+        # 1e-4 or at/above 1e16), so the strings coincide digit for digit
+        return sign + repr(a)
+    # normalize shortest-round-trip digits to d.ddd * 10^dec_exp
+    r = repr(a)
+    if "e" in r:
+        mant, _, exp_s = r.partition("e")
+        digits = mant.replace(".", "")
+        dec_exp = int(exp_s)
+    else:
+        int_part, _, frac = r.partition(".")
+        if int_part != "0":
+            digits = (int_part + frac).lstrip("0")
+            dec_exp = len(int_part) - 1
+        else:
+            stripped = frac.lstrip("0")
+            digits = stripped
+            dec_exp = -(len(frac) - len(stripped) + 1)
+    digits = digits.rstrip("0") or "0"
+    mantissa = digits[0] + "." + (digits[1:] or "0")
+    return f"{sign}{mantissa}E{dec_exp}"
+
+
 def _spark_string_cast(value) -> str:
     """Format a value the way Spark's cast-to-string would (booleans
-    lowercase, floats like '1.0')."""
+    lowercase, doubles via Java ``Double.toString``)."""
     if isinstance(value, (bool, np.bool_)):
         return "true" if value else "false"
     if isinstance(value, (float, np.floating)):
-        return repr(float(value)) if not float(value).is_integer() else f"{value:.1f}"
+        return _java_double_to_string(float(value))
     if isinstance(value, (int, np.integer)):
         return str(int(value))
     return str(value)
@@ -590,7 +632,7 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
             # vectorized: count raw PRESENT values first (cheap),
             # Spark-string-cast only the distinct keys; nullness comes from
             # the validity mask, never from the value (a genuine float NaN
-            # keys as 'nan', a null as NullValue)
+            # keys as 'NaN' per Java Double.toString, a null as NullValue)
             present_values = values[present]
             if present_values.dtype == object:
                 counts = pd.Series(present_values).value_counts(sort=False, dropna=False)
