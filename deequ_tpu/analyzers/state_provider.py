@@ -24,6 +24,20 @@ import numpy as np
 from .base import Analyzer
 from .grouping import FrequenciesAndNumRows
 
+#: Version of the persisted state layout (.npz leaf blobs + frequency
+#: parquet/meta sidecars). Bump on ANY change to a state pytree's leaf
+#: order/shapes or the sidecar schema; the loader refuses newer versions
+#: instead of misreading them (SURVEY §7 hard part 5). v1 is frozen by
+#: tests/test_state_serde.py::TestFormatVersioning::test_v1_npz_layout_pinned.
+STATE_FORMAT_VERSION = 1
+
+
+def _check_state_version(found: int, kind: str) -> None:
+    if found > STATE_FORMAT_VERSION or found < 1:
+        from ..exceptions import UnsupportedFormatVersionError
+
+        raise UnsupportedFormatVersionError(kind, found, STATE_FORMAT_VERSION)
+
 
 class StateLoader:
     def load(self, analyzer: Analyzer) -> Optional[Any]:
@@ -59,12 +73,18 @@ class InMemoryStateProvider(StateLoader, StatePersister):
 class FileSystemStateProvider(StateLoader, StatePersister):
     """Directory-backed state store (reference `HdfsStateProvider`,
     `StateProvider.scala:73-312`). Each analyzer's state lands in files keyed
-    by a stable hash of the analyzer's identity."""
+    by a stable hash of the analyzer's identity. ``path`` may be a local
+    directory or any URI scheme `deequ_tpu.io` supports (``s3://``,
+    ``gs://``, ``memory://``, ...), so a multi-host pod can merge
+    day-partition states through shared storage the way the reference does
+    through HDFS."""
 
     def __init__(self, path: str, allow_overwrite: bool = True):
+        from .. import io as dio
+
         self.path = path
         self.allow_overwrite = allow_overwrite
-        os.makedirs(path, exist_ok=True)
+        dio.makedirs(path)
 
     def _key(self, analyzer: Analyzer) -> str:
         import hashlib
@@ -73,8 +93,12 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         return f"{analyzer.name}-{digest}"
 
     def persist(self, analyzer: Analyzer, state: Any) -> None:
-        base = os.path.join(self.path, self._key(analyzer))
+        from .. import io as dio
+
+        base = dio.join(self.path, self._key(analyzer))
         if isinstance(state, FrequenciesAndNumRows):
+            import pyarrow as pa
+
             # name index levels after the group columns: value_counts-built
             # series (Histogram) have unnamed indexes that would otherwise
             # round-trip as a column literally called "index"
@@ -83,30 +107,48 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 .rename_axis(state.group_columns)
                 .reset_index()
             )
-            frame.to_parquet(base + "-frequencies.parquet")
-            with open(base + "-meta.json", "w", encoding="utf-8") as fh:
+            dio.write_parquet_table(
+                pa.Table.from_pandas(frame, preserve_index=False),
+                base + "-frequencies.parquet",
+            )
+            with dio.open_file(base + "-meta.json", "w") as fh:
                 json.dump(
-                    {"num_rows": state.num_rows, "group_columns": state.group_columns}, fh
+                    {
+                        "formatVersion": STATE_FORMAT_VERSION,
+                        "num_rows": state.num_rows,
+                        "group_columns": state.group_columns,
+                    },
+                    fh,
                 )
             return
         # numpy/jax pytree: flatten to arrays + structure pickle
         import jax
 
         leaves, treedef = jax.tree_util.tree_flatten(state)
-        np.savez(
-            base + "-state.npz", **{f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)}
-        )
-        with open(base + "-treedef.pkl", "wb") as fh:
+        with dio.open_file(base + "-state.npz", "wb") as fh:
+            np.savez(
+                fh,
+                __format_version__=np.int64(STATE_FORMAT_VERSION),
+                **{f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)},
+            )
+        with dio.open_file(base + "-treedef.pkl", "wb") as fh:
             pickle.dump((type(state).__name__, treedef), fh)
 
     def load(self, analyzer: Analyzer) -> Optional[Any]:
-        base = os.path.join(self.path, self._key(analyzer))
-        if os.path.exists(base + "-frequencies.parquet"):
+        from .. import io as dio
+
+        base = dio.join(self.path, self._key(analyzer))
+        if dio.exists(base + "-frequencies.parquet"):
+            frame = dio.read_parquet_table(base + "-frequencies.parquet").to_pandas()
+            with dio.open_file(base + "-meta.json", "r") as fh:
+                meta = json.load(fh)
+            # sidecars from before versioning (round <=3) carry no marker
+            # and ARE the v1 layout
+            _check_state_version(
+                int(meta.get("formatVersion", 1)), "frequency-state sidecar"
+            )
             import pandas as pd
 
-            frame = pd.read_parquet(base + "-frequencies.parquet")
-            with open(base + "-meta.json", "r", encoding="utf-8") as fh:
-                meta = json.load(fh)
             cols = meta["group_columns"]
             series = frame.set_index(cols)["count"]
             if len(cols) == 1:
@@ -114,12 +156,18 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                     series.index, pd.MultiIndex
                 ) else series.index
             return FrequenciesAndNumRows(series, meta["num_rows"], cols)
-        if os.path.exists(base + "-state.npz"):
+        if dio.exists(base + "-state.npz"):
+            import io as _io
+
             import jax
 
-            with open(base + "-treedef.pkl", "rb") as fh:
+            with dio.open_file(base + "-treedef.pkl", "rb") as fh:
                 _, treedef = pickle.load(fh)
-            data = np.load(base + "-state.npz")
-            leaves = [data[f"leaf{i}"] for i in range(len(data.files))]
+            with dio.open_file(base + "-state.npz", "rb") as fh:
+                data = np.load(_io.BytesIO(fh.read()))
+            if "__format_version__" in data.files:
+                _check_state_version(int(data["__format_version__"]), ".npz state blob")
+            n_leaves = sum(1 for f in data.files if f.startswith("leaf"))
+            leaves = [data[f"leaf{i}"] for i in range(n_leaves)]
             return jax.tree_util.tree_unflatten(treedef, leaves)
         return None

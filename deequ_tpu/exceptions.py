@@ -45,6 +45,23 @@ class EmptyStateException(MetricCalculationRuntimeException):
     """All input values were null/filtered — no state to finalize."""
 
 
+class UnsupportedFormatVersionError(Exception):
+    """A persisted payload (metrics-history JSON or .npz state blob) carries
+    a format version this build does not understand. Raised INSTEAD of
+    silently misreading a layout from a newer build (SURVEY §7 hard part 5:
+    incremental-state serialization stability across versions)."""
+
+    def __init__(self, kind: str, found: int, supported: int):
+        self.kind = kind
+        self.found = found
+        self.supported = supported
+        super().__init__(
+            f"{kind} format version {found} is not supported by this build "
+            f"(max supported: {supported}). Upgrade deequ_tpu to read this "
+            f"payload, or re-materialize it with the current build."
+        )
+
+
 def wrap_if_necessary(exception: BaseException) -> MetricCalculationException:
     """Wrap arbitrary errors into the taxonomy
     (reference `MetricCalculationException.scala:70-78`)."""

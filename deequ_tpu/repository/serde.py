@@ -59,6 +59,13 @@ class SerializationError(ValueError):
     pass
 
 
+#: Version of the metrics-history JSON layout. Bump on ANY change to the
+#: analyzer<->JSON mapping or metric payload shapes; the loader refuses
+#: newer versions instead of misreading them. v1 layout is frozen by
+#: tests/test_state_serde.py::TestFormatVersioning::test_v1_json_layout_pinned.
+SERDE_FORMAT_VERSION = 1
+
+
 def _ser_where(where) -> Optional[str]:
     if where is None:
         return None
@@ -254,6 +261,7 @@ def serialize_result(result) -> Dict[str, Any]:
         except SerializationError:
             continue  # skip non-serializable analyzers, keep the rest
     return {
+        "formatVersion": SERDE_FORMAT_VERSION,
         "resultKey": {
             "dataSetDate": result.result_key.data_set_date,
             "tags": result.result_key.tags_dict,
@@ -265,6 +273,15 @@ def serialize_result(result) -> Dict[str, Any]:
 def deserialize_result(d: Dict[str, Any]):
     from . import AnalysisResult, ResultKey
 
+    # payloads from before versioning (round <=3) carry no marker and ARE
+    # the v1 layout; anything newer than this build understands is refused
+    version = int(d.get("formatVersion", 1))
+    if version > SERDE_FORMAT_VERSION or version < 1:
+        from ..exceptions import UnsupportedFormatVersionError
+
+        raise UnsupportedFormatVersionError(
+            "metrics-history JSON", version, SERDE_FORMAT_VERSION
+        )
     key = ResultKey(d["resultKey"]["dataSetDate"], d["resultKey"].get("tags", {}))
     metric_map = {}
     for pair in d["analyzerContext"]["metricMap"]:

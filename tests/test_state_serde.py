@@ -267,3 +267,137 @@ class TestMergeAlgebraMatrix:
         med = merged.metric(ApproxQuantile("x", 0.5)).value.get()
         rank_err = abs((xs <= med).mean() - 0.5)
         assert rank_err < 0.02, (med, rank_err)
+
+
+class TestFormatVersioning:
+    """VERDICT r3 missing #2 / SURVEY §7 hard part 5: persisted formats carry
+    an explicit version; loaders refuse versions they do not understand with
+    a typed, actionable error instead of silently misreading the layout."""
+
+    def test_json_roundtrip_carries_version(self):
+        from deequ_tpu.repository import AnalysisResult, ResultKey
+        from deequ_tpu.repository.serde import (
+            SERDE_FORMAT_VERSION,
+            deserialize_results,
+            serialize_result,
+            serialize_results,
+        )
+        from deequ_tpu.runners.context import AnalyzerContext
+
+        result = AnalysisResult(ResultKey(1234, {"t": "v"}), AnalyzerContext({}))
+        d = serialize_result(result)
+        assert d["formatVersion"] == SERDE_FORMAT_VERSION
+        back = deserialize_results(serialize_results([result]))
+        assert back[0].result_key == result.result_key
+
+    def test_json_unknown_version_raises(self):
+        import json as _json
+
+        from deequ_tpu.exceptions import UnsupportedFormatVersionError
+        from deequ_tpu.repository.serde import deserialize_results
+
+        payload = _json.dumps(
+            [{"formatVersion": 99, "resultKey": {"dataSetDate": 0, "tags": {}},
+              "analyzerContext": {"metricMap": []}}]
+        )
+        with pytest.raises(UnsupportedFormatVersionError, match="version 99"):
+            deserialize_results(payload)
+
+    def test_json_missing_version_is_v1(self):
+        import json as _json
+
+        from deequ_tpu.repository.serde import deserialize_results
+
+        payload = _json.dumps(
+            [{"resultKey": {"dataSetDate": 7, "tags": {}},
+              "analyzerContext": {"metricMap": []}}]
+        )
+        assert deserialize_results(payload)[0].result_key.data_set_date == 7
+
+    def test_npz_roundtrip_carries_version(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import (
+            STATE_FORMAT_VERSION,
+            FileSystemStateProvider,
+        )
+
+        data = Dataset.from_dict({"x": np.arange(10, dtype=np.float64)})
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Mean("x")
+        AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+        npz_files = list(tmp_path.glob("*-state.npz"))
+        assert npz_files
+        payload = np.load(npz_files[0])
+        assert int(payload["__format_version__"]) == STATE_FORMAT_VERSION
+        state = sp.load(a)
+        assert a.compute_metric_from(state).value.get() == pytest.approx(4.5)
+
+    def test_npz_unknown_version_raises(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+        from deequ_tpu.exceptions import UnsupportedFormatVersionError
+
+        data = Dataset.from_dict({"x": np.arange(10, dtype=np.float64)})
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Mean("x")
+        AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+        npz_file = next(iter(tmp_path.glob("*-state.npz")))
+        payload = dict(np.load(npz_file))
+        payload["__format_version__"] = np.int64(99)
+        np.savez(npz_file, **payload)
+        with pytest.raises(UnsupportedFormatVersionError, match="version 99"):
+            sp.load(a)
+
+    def test_frequency_sidecar_unknown_version_raises(self, tmp_path):
+        import json as _json
+
+        from deequ_tpu.analyzers import Uniqueness
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+        from deequ_tpu.exceptions import UnsupportedFormatVersionError
+
+        data = Dataset.from_dict({"s": np.array(["a", "b", "a"], dtype=object)})
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Uniqueness("s")
+        AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+        meta_file = next(iter(tmp_path.glob("*-meta.json")))
+        meta = _json.loads(meta_file.read_text())
+        meta["formatVersion"] = 99
+        meta_file.write_text(_json.dumps(meta))
+        with pytest.raises(UnsupportedFormatVersionError, match="version 99"):
+            sp.load(a)
+
+    def test_v1_json_layout_pinned(self):
+        """Freeze the v1 metrics-history JSON byte layout: if this test
+        breaks, you changed the persistence schema — bump
+        SERDE_FORMAT_VERSION and add a migration path."""
+        import json as _json
+
+        from deequ_tpu.metrics import DoubleMetric, Entity, Success
+        from deequ_tpu.repository import AnalysisResult, ResultKey
+        from deequ_tpu.repository.serde import serialize_results
+        from deequ_tpu.runners.context import AnalyzerContext
+
+        a = Mean("x")
+        metric = DoubleMetric(Entity.COLUMN, "Mean", "x", Success(4.5))
+        result = AnalysisResult(ResultKey(1700000000000, {"env": "t"}),
+                                AnalyzerContext({a: metric}))
+        frozen = (
+            '[{"formatVersion": 1, "resultKey": {"dataSetDate": 1700000000000, '
+            '"tags": {"env": "t"}}, "analyzerContext": {"metricMap": '
+            '[{"analyzer": {"analyzerName": "Mean", "column": "x", "where": null}, '
+            '"metric": {"entity": "Column", "instance": "x", "name": "Mean", '
+            '"metricName": "DoubleMetric", "value": 4.5}}]}}]'
+        )
+        assert serialize_results([result]) == frozen
+        assert _json.loads(frozen)  # stays valid JSON
+
+    def test_v1_npz_layout_pinned(self, tmp_path):
+        """Freeze the v1 .npz state layout for MeanState: leaf order is
+        (total, count). If this breaks, bump STATE_FORMAT_VERSION."""
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        data = Dataset.from_dict({"x": np.arange(10, dtype=np.float64)})
+        sp = FileSystemStateProvider(str(tmp_path))
+        AnalysisRunner.do_analysis_run(data, [Mean("x")], save_states_with=sp)
+        payload = np.load(next(iter(tmp_path.glob("*-state.npz"))))
+        assert sorted(payload.files) == ["__format_version__", "leaf0", "leaf1"]
+        assert float(payload["leaf0"]) == 45.0   # sum
+        assert int(payload["leaf1"]) == 10       # count
