@@ -236,7 +236,13 @@ class Dataset:
 
     @staticmethod
     def from_parquet(path: Union[str, Sequence[str]]) -> "Dataset":
-        return Dataset(pq.read_table(path))
+        """Read Parquet from a local path or any supported URI scheme
+        (``s3://``, ``gs://``, ``hdfs://``, ``memory://``, ...) — the
+        reference reads through Hadoop `FileSystem` the same way
+        (`io/DfsUtils.scala:24-85`)."""
+        from .. import io as dio
+
+        return Dataset(dio.read_parquet_table(path))
 
     @staticmethod
     def from_pandas(df) -> "Dataset":

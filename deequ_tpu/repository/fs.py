@@ -1,14 +1,16 @@
 """File-backed metrics repository: the whole history lives in ONE json file;
 save = read-all, replace-key, rewrite — simple and atomic enough for metric
 histories, exactly the reference's strategy
-(reference `repository/fs/FileSystemMetricsRepository.scala:41-57`)."""
+(reference `repository/fs/FileSystemMetricsRepository.scala:41-57`). The
+path may be local or any URI scheme `deequ_tpu.io` supports (``s3://``,
+``gs://``, ``memory://``, ...) — the reference reads/writes the same file
+through Hadoop `FileSystem` (`io/DfsUtils.scala:24-85`)."""
 
 from __future__ import annotations
 
-import os
-import tempfile
 from typing import List, Optional
 
+from .. import io as dio
 from ..runners.context import AnalyzerContext
 from . import (
     AnalysisResult,
@@ -30,17 +32,9 @@ class FileSystemMetricsRepository(MetricsRepository):
         existing = [r for r in self._read_all() if r.result_key != result_key]
         existing.append(AnalysisResult(result_key, successful))
         payload = serialize_results(existing)
-        # write-rename so a crash mid-write never corrupts the history
-        directory = os.path.dirname(os.path.abspath(self.path)) or "."
-        os.makedirs(directory, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(payload)
-            os.replace(tmp, self.path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        # local: write-rename so a crash mid-write never corrupts the
+        # history; object stores: one atomic put
+        dio.write_text_atomic(self.path, payload)
 
     def load_by_key(self, result_key: ResultKey) -> Optional[AnalyzerContext]:
         for result in self._read_all():
@@ -52,9 +46,9 @@ class FileSystemMetricsRepository(MetricsRepository):
         return FileSystemMetricsRepositoryMultipleResultsLoader(self)
 
     def _read_all(self) -> List[AnalysisResult]:
-        if not os.path.exists(self.path):
+        if not dio.exists(self.path):
             return []
-        with open(self.path) as f:
+        with dio.open_file(self.path, "r") as f:
             payload = f.read()
         if not payload.strip():
             return []
