@@ -268,9 +268,14 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
     import jax
 
     def _leaf_sig(leaf):
-        # metadata only — np.asarray here would force a blocking D2H copy of
-        # every leaf of every state before the fold even dispatches
-        return (getattr(leaf, "shape", ()), np.dtype(leaf.dtype))
+        # metadata only — np.asarray on an ARRAY leaf would force a blocking
+        # D2H copy of every leaf of every state before the fold dispatches;
+        # python-scalar leaves (no .dtype) are host values, cheap to probe
+        dt = getattr(leaf, "dtype", None)
+        if dt is None:
+            a = np.asarray(leaf)
+            return (a.shape, a.dtype)
+        return (getattr(leaf, "shape", ()), np.dtype(dt))
 
     leaves, treedef = jax.tree_util.tree_flatten(states[0])
     array_like = bool(leaves) and all(

