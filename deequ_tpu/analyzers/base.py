@@ -259,7 +259,10 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
     dispatching each merge's ops eagerly — on remote-tunnel devices an eager
     KLL merge alone costs ~100 dispatch round trips. States that are not
     array pytrees (e.g. frequency tables) fold sequentially on the host.
-    Result order equals the left-to-right sequential fold."""
+    Result order equals the left-to-right sequential fold. (A log-depth
+    tree of VMAPPED pairwise merges was measured 4x SLOWER for KLL states
+    on a v5e chip — the compaction cascade's dynamic_update_slices lower to
+    gathers under vmap — so the sequential scan stays; see PERF.md.)"""
     states = [s for s in states if s is not None]
     if not states:
         return None

@@ -61,7 +61,130 @@ FREQ_BUDGET_ENV = "DEEQU_TPU_MAX_FREQUENCY_ENTRIES"
 
 
 class FrequencyBudgetExceeded(RuntimeError):
-    """Distinct-group count crossed DEEQU_TPU_MAX_FREQUENCY_ENTRIES."""
+    """Distinct-group count crossed DEEQU_TPU_MAX_FREQUENCY_ENTRIES (with
+    spilling disabled), or a spilled table was asked to fully materialize."""
+
+
+#: set to "0" to disable spilling and restore the hard budget failure
+FREQ_SPILL_ENV = "DEEQU_TPU_FREQUENCY_SPILL"
+#: number of hash partitions a spilled table is scattered over
+FREQ_SPILL_PARTITIONS_ENV = "DEEQU_TPU_FREQUENCY_SPILL_PARTITIONS"
+_DEFAULT_SPILL_PARTITIONS = 64
+
+
+class _SpillStore:
+    """Hash-partitioned spill files for an over-budget frequency table —
+    the analog of the Spark shuffle spill the reference leans on
+    (`GroupingAnalyzers.scala:53-80` runs on Spark's hash aggregation,
+    which spills sorted run files per hash partition when memory runs out).
+
+    Every spill event scatters the resident table over P partitions by a
+    stable row hash and appends one parquet run file per non-empty
+    partition. A key lives in exactly ONE partition, so reading a
+    partition's runs + one concat/groupby yields FINAL counts for its keys
+    with peak memory ~ (total appended entries)/P — never the whole table.
+    """
+
+    #: sentinel column names inside spill parquet files — user key columns
+    #: may be named anything (including "count"), so frames never use the
+    #: user-visible names
+    _COUNT = "__deequ_count__"
+
+    def __init__(self, group_columns: Sequence[str]):
+        import os
+        import shutil
+        import tempfile
+        import weakref
+
+        self.group_columns = list(group_columns)
+        self._key_cols = [f"__deequ_key{i}__" for i in range(len(self.group_columns))]
+        try:
+            self.partitions = max(
+                1, int(os.environ.get(FREQ_SPILL_PARTITIONS_ENV, _DEFAULT_SPILL_PARTITIONS))
+            )
+        except ValueError:
+            self.partitions = _DEFAULT_SPILL_PARTITIONS
+        self.dir = tempfile.mkdtemp(prefix="deequ-tpu-freq-spill-")
+        self._runs = 0
+        self.entries_spilled = 0
+        self._finalizer = weakref.finalize(self, shutil.rmtree, self.dir, True)
+
+    def _partition_of(self, frame: pd.DataFrame) -> np.ndarray:
+        """Stable per-row hash partition from the KEY COLUMNS (hashing the
+        index directly trips pandas' Categorical factorization on NaN level
+        values; plain columns hash NaN by bit pattern)."""
+        codes = pd.util.hash_pandas_object(
+            frame[self._key_cols], index=False
+        ).to_numpy()
+        return (codes % np.uint64(self.partitions)).astype(np.int64)
+
+    def _to_frame(self, counts: pd.Series) -> pd.DataFrame:
+        return counts.rename(self._COUNT).rename_axis(self._key_cols).reset_index()
+
+    def append(self, counts: pd.Series) -> None:
+        """Scatter one resident table over the hash partitions."""
+        import os
+
+        if len(counts) == 0:
+            return
+        frame = self._to_frame(counts)
+        part_of = self._partition_of(frame)
+        run = self._runs
+        self._runs += 1
+        for p in np.unique(part_of):
+            sub = frame.iloc[np.flatnonzero(part_of == p)]
+            pdir = os.path.join(self.dir, f"part{p:05d}")
+            os.makedirs(pdir, exist_ok=True)
+            sub.to_parquet(os.path.join(pdir, f"run{run:05d}.parquet"), index=False)
+        self.entries_spilled += len(counts)
+
+    def iter_partition_counts(self, extra: Optional[pd.Series] = None):
+        """Yield one FINAL count Series per partition (every key exactly
+        once across all yields). ``extra`` is a not-yet-spilled resident
+        table folded in (hashed with the same function)."""
+        import os
+
+        extra_parts: Dict[int, pd.Series] = {}
+        if extra is not None and len(extra):
+            part_of = self._partition_of(self._to_frame(extra))
+            for p in np.unique(part_of):
+                extra_parts[int(p)] = extra.iloc[np.flatnonzero(part_of == p)]
+        for p in range(self.partitions):
+            pdir = os.path.join(self.dir, f"part{p:05d}")
+            pieces: List[pd.Series] = []
+            if os.path.isdir(pdir):
+                for name in sorted(os.listdir(pdir)):
+                    frame = pd.read_parquet(os.path.join(pdir, name))
+                    series = frame.set_index(self._key_cols)[self._COUNT]
+                    if len(self._key_cols) == 1 and isinstance(
+                        series.index, pd.MultiIndex
+                    ):
+                        series.index = series.index.get_level_values(0)
+                    # restore the user-visible level names for consumers that
+                    # read keys (Histogram, MutualInformation marginals)
+                    series = series.rename_axis(
+                        self.group_columns if len(self.group_columns) > 1
+                        else self.group_columns[0]
+                    )
+                    pieces.append(series)
+            if p in extra_parts:
+                pieces.append(extra_parts[p])
+            if not pieces:
+                continue
+            if len(pieces) == 1:
+                yield pieces[0].astype(np.int64)
+                continue
+            cat = pd.concat(pieces)
+            levels = (
+                list(range(cat.index.nlevels))
+                if isinstance(cat.index, pd.MultiIndex)
+                else 0
+            )
+            yield (
+                cat.groupby(level=levels, sort=False, dropna=False)
+                .sum()
+                .astype(np.int64)
+            )
 
 
 class FrequenciesAndNumRows:
@@ -84,13 +207,34 @@ class FrequenciesAndNumRows:
         self._merged = frequencies  # index = group keys (tuples for multi-col)
         self._runs: List[pd.Series] = []
         self._buffered = 0
+        self._spill: Optional[_SpillStore] = None
+        self._summary: Optional[Tuple[int, int, int, float]] = None
         self.num_rows = int(num_rows)
         self.group_columns = list(group_columns)
 
     @property
+    def spilled(self) -> bool:
+        """True once the table crossed the budget and lives (partly) in
+        hash-partitioned spill files instead of RAM."""
+        return self._spill is not None
+
+    @property
     def frequencies(self) -> pd.Series:
-        """The merged frequency table (forces a flush of buffered runs)."""
+        """The merged frequency table (forces a flush of buffered runs).
+
+        A SPILLED table refuses to materialize: consumers that need the
+        whole table at once (state persistence, incremental ``sum`` merge)
+        fail with the same clean FrequencyBudgetExceeded the hard budget
+        used to raise; streaming consumers use ``iter_merged_chunks``."""
         self._flush()
+        if self._spill is not None:
+            raise FrequencyBudgetExceeded(
+                f"frequency table for {self.group_columns} spilled "
+                f"{self._spill.entries_spilled} entries to disk under the "
+                f"{FREQ_BUDGET_ENV} budget; full-table materialization is "
+                "not available (set a larger budget, or use a streaming "
+                "consumer)"
+            )
         return self._merged
 
     @frequencies.setter
@@ -98,6 +242,57 @@ class FrequenciesAndNumRows:
         self._merged = value
         self._runs = []
         self._buffered = 0
+        self._spill = None
+        self._summary = None
+
+    def iter_merged_chunks(self):
+        """Yield FINAL count Series chunks, each group exactly once across
+        all chunks — the streaming read every frequency reduction uses.
+        Unspilled tables yield themselves in one chunk; spilled tables
+        k-way-merge their hash partitions at ~1/P of the table per step."""
+        self._flush()
+        if self._spill is None:
+            if len(self._merged):
+                yield self._merged
+            return
+        yield from self._spill.iter_partition_counts(
+            self._merged if len(self._merged) else None
+        )
+
+    def num_distinct(self) -> int:
+        """Number of distinct groups; streams when spilled."""
+        self._flush()  # may create the spill store
+        if self._spill is None:
+            return len(self._merged)
+        return self.stream_summary()[0]
+
+    def stream_summary(self) -> Tuple[int, int, int, float]:
+        """(num_distinct, singleton_count, sum(count), sum(count*ln(count)))
+        computed in ONE streaming pass and cached — every scalar frequency
+        reduction (Uniqueness, Distinctness, UniqueValueRatio,
+        CountDistinct, Entropy) reads these, so a 5-analyzer battery over a
+        spilled table costs one disk pass, not five. Invalidated whenever
+        new counts are appended."""
+        if self._summary is None:
+            nd = 0
+            singles = 0
+            total = 0
+            c_ln_c = 0.0
+            for chunk in self.iter_merged_chunks():
+                c = chunk.to_numpy(dtype=np.float64)
+                nd += len(c)
+                singles += int((c == 1).sum())
+                total += int(c.sum())
+                pos = c[c > 0]
+                c_ln_c += float((pos * np.log(pos)).sum())
+            self._summary = (nd, singles, total, c_ln_c)
+        return self._summary
+
+    def is_empty(self) -> bool:
+        self._flush()  # may create the spill store
+        if self._spill is not None:
+            return False  # a spilled table crossed the budget: never empty
+        return len(self._merged) == 0
 
     def _budget(self) -> int:
         import os
@@ -106,6 +301,11 @@ class FrequenciesAndNumRows:
             return int(os.environ.get(FREQ_BUDGET_ENV, "0"))
         except ValueError:
             return 0
+
+    def _spill_enabled(self) -> bool:
+        import os
+
+        return os.environ.get(FREQ_SPILL_ENV, "1") != "0"
 
     def _flush(self) -> None:
         if not self._runs:
@@ -131,10 +331,18 @@ class FrequenciesAndNumRows:
             )
         budget = self._budget()
         if budget and len(merged) > budget:
-            raise FrequencyBudgetExceeded(
-                f"frequency table for {self.group_columns} holds {len(merged)} "
-                f"distinct groups, over the {FREQ_BUDGET_ENV}={budget} budget"
-            )
+            if not self._spill_enabled():
+                raise FrequencyBudgetExceeded(
+                    f"frequency table for {self.group_columns} holds {len(merged)} "
+                    f"distinct groups, over the {FREQ_BUDGET_ENV}={budget} budget"
+                )
+            # over budget: scatter the resident table to the hash-partition
+            # spill files and keep RAM bounded by ~budget entries (the Spark
+            # shuffle-spill analog, `GroupingAnalyzers.scala:53-80`)
+            if self._spill is None:
+                self._spill = _SpillStore(self.group_columns)
+            self._spill.append(merged)
+            merged = pd.Series([], dtype=np.int64)
         self._merged = merged
         self._runs = []
         self._buffered = 0
@@ -142,6 +350,7 @@ class FrequenciesAndNumRows:
     def _append_run(self, counts: pd.Series) -> None:
         if len(counts) == 0:
             return
+        self._summary = None
         self._runs.append(counts)
         self._buffered += len(counts)
         if self._buffered >= max(len(self._merged), MIN_FLUSH_ENTRIES):
@@ -365,7 +574,7 @@ class ScanShareableFrequencyBasedAnalyzer(GroupingAnalyzer):
     def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
         if state is None:
             return metric_from_empty(self.name, self.instance, self.entity)
-        if self.empty_frequencies_are_empty_metric and len(state.frequencies) == 0:
+        if self.empty_frequencies_are_empty_metric and state.is_empty():
             return metric_from_empty(self.name, self.instance, self.entity)
         try:
             value = self.metric_from_frequencies(state)
@@ -394,7 +603,7 @@ class Uniqueness(ScanShareableFrequencyBasedAnalyzer):
     def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
         if state.num_rows == 0:
             return float("nan")
-        return float((state.frequencies == 1).sum()) / state.num_rows
+        return float(state.stream_summary()[1]) / state.num_rows
 
 
 @dataclass(frozen=True)
@@ -411,7 +620,7 @@ class Distinctness(ScanShareableFrequencyBasedAnalyzer):
     def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
         if state.num_rows == 0:
             return float("nan")
-        return float((state.frequencies >= 1).sum()) / state.num_rows
+        return float(state.num_distinct()) / state.num_rows
 
 
 @dataclass(frozen=True)
@@ -426,10 +635,10 @@ class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
         object.__setattr__(self, "columns", _as_tuple(columns))
 
     def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
-        num_groups = len(state.frequencies)
+        num_groups, singletons, _, _ = state.stream_summary()
         if num_groups == 0:
             return float("nan")
-        return float((state.frequencies == 1).sum()) / num_groups
+        return float(singletons) / num_groups
 
 
 @dataclass(frozen=True)
@@ -444,7 +653,7 @@ class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
         object.__setattr__(self, "columns", _as_tuple(columns))
 
     def metric_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
-        return float(len(state.frequencies))
+        return float(state.num_distinct())
 
 
 @dataclass(frozen=True)
@@ -462,10 +671,9 @@ class Entropy(ScanShareableFrequencyBasedAnalyzer):
         n = state.num_rows
         if n == 0:
             return float("nan")
-        c = state.frequencies.to_numpy(dtype=np.float64)
-        c = c[c > 0]
-        p = c / n
-        return float(-(p * np.log(p)).sum())
+        # -sum (c/n) ln(c/n) = ln(n) * sum(c)/n - sum(c ln c)/n
+        _, _, total, c_ln_c = state.stream_summary()
+        return float(math.log(n) * total / n - c_ln_c / n)
 
 
 @dataclass(frozen=True)
@@ -487,17 +695,27 @@ class MutualInformation(GroupingAnalyzer):
         return [Preconditions.exactly_n_columns(self.columns, 2)] + super().preconditions()
 
     def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
-        if state is None or len(state.frequencies) == 0:
+        if state is None or state.is_empty():
             return metric_from_empty(self.name, self.instance, self.entity)
         try:
             total = state.num_rows
-            joint = state.frequencies  # MultiIndex (col1, col2) -> count
-            px = joint.groupby(level=0).sum()
-            py = joint.groupby(level=1).sum()
-            pxy = joint.to_numpy(dtype=np.float64) / total
-            px_row = px.loc[joint.index.get_level_values(0)].to_numpy(dtype=np.float64) / total
-            py_row = py.loc[joint.index.get_level_values(1)].to_numpy(dtype=np.float64) / total
-            value = float((pxy * np.log(pxy / (px_row * py_row))).sum())
+            # two streaming passes over the joint table: marginals first,
+            # then the MI sum. Memory = marginal cardinalities (always <=
+            # the joint's), so a spilled joint still completes as long as
+            # the per-column distinct counts fit in RAM.
+            px: Optional[pd.Series] = None
+            py: Optional[pd.Series] = None
+            for joint in state.iter_merged_chunks():
+                cx = joint.groupby(level=0).sum()
+                cy = joint.groupby(level=1).sum()
+                px = cx if px is None else px.add(cx, fill_value=0)
+                py = cy if py is None else py.add(cy, fill_value=0)
+            value = 0.0
+            for joint in state.iter_merged_chunks():
+                pxy = joint.to_numpy(dtype=np.float64) / total
+                px_row = px.loc[joint.index.get_level_values(0)].to_numpy(dtype=np.float64) / total
+                py_row = py.loc[joint.index.get_level_values(1)].to_numpy(dtype=np.float64) / total
+                value += float((pxy * np.log(pxy / (px_row * py_row))).sum())
         except Exception as exc:  # noqa: BLE001
             return metric_from_failure(wrap_if_necessary(exc), self.name, self.instance, self.entity)
         return metric_from_value(value, self.name, self.instance, self.entity)
@@ -687,8 +905,16 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
                 self.column,
             )
         try:
-            bin_count = len(state.frequencies)
-            top = state.frequencies.sort_values(ascending=False).head(self.max_detail_bins)
+            bin_count = 0
+            top: Optional[pd.Series] = None
+            for chunk in state.iter_merged_chunks():
+                bin_count += len(chunk)
+                cand = chunk.nlargest(self.max_detail_bins)
+                top = cand if top is None else pd.concat([top, cand]).nlargest(
+                    self.max_detail_bins
+                )
+            if top is None:
+                top = pd.Series([], dtype=np.int64)
             values = {
                 str(k): DistributionValue(int(v), int(v) / state.num_rows)
                 for k, v in top.items()
