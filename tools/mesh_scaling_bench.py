@@ -1,0 +1,188 @@
+"""Multi-device scaling evidence on the virtual CPU mesh (VERDICT r3 ask #8).
+
+Times, across mesh sizes, with a realistic 27-analyzer battery (HLL + KLL
+sketch payloads included):
+
+1. `collective_merge_states` — the butterfly (power-of-two meshes) vs the
+   all-gather fallback (non-power-of-two), across shard counts;
+2. `sharded_ingest_fold` — host-partial chunks folded over the mesh vs the
+   equivalent single-device sequential fold.
+
+Run it with N virtual CPU devices (no TPU pod needed — same GSPMD programs,
+different interconnect constants):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/mesh_scaling_bench.py
+
+CPU "collectives" are shared-memory copies, so absolute times model nothing;
+what transfers to a v5e-8 is the SHAPE: program counts, collective rounds
+(log2(n) for butterfly vs one fat all-gather), and the per-device fold work
+(shards/n). See PERF.md "Multi-device scaling model" for the ICI arithmetic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def battery():
+    """27 analyzers with realistic state payloads: 2 KLL sketches (the fat
+    states), 2 HLLs, and 23 scalar-state reductions."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        KLLSketch,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+
+    out = [Size()]
+    for i in range(4):
+        c = f"x{i}"
+        out += [Completeness(c), Mean(c), Sum(c), Minimum(c), Maximum(c)]
+    out += [StandardDeviation("x0"), StandardDeviation("x1")]
+    out += [ApproxCountDistinct(c) for c in ("x0", "x1")]
+    out += [KLLSketch("x0"), KLLSketch("x1")]
+    assert len(out) == 27, len(out)
+    return out
+
+
+def build_shard_states(analyzers, n_shards: int, rows_per_shard: int = 1 << 12):
+    """Per-shard states with REAL content (each shard updated on distinct
+    data), stacked along a leading shard dim."""
+    from deequ_tpu.runners.engine import ScanEngine
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(5)
+    per_shard = []
+    engine = ScanEngine(analyzers, placement="device")
+    program = engine._update
+    for s in range(n_shards):
+        cols = {
+            f"x{i}": rng.normal(10 * i + s, 3, rows_per_shard) for i in range(4)
+        }
+        batch = None
+        for batch in Dataset.from_dict(cols).batches(
+            rows_per_shard, columns=engine.required_columns()
+        ):
+            break
+        features = engine._prepare(batch)
+        states = program(tuple(a.init_state() for a in analyzers), features)
+        per_shard.append(states)
+    stacked = tuple(
+        jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[p[i] for p in per_shard])
+        for i in range(len(analyzers))
+    )
+    jax.block_until_ready(stacked)
+    return stacked
+
+
+def time_merge(analyzers, mesh, stacked, repeats: int = 3) -> float:
+    from deequ_tpu.parallel import collective_merge_states
+
+    collective_merge_states(analyzers, mesh, stacked)  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = collective_merge_states(analyzers, mesh, stacked)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def time_sequential_fold(analyzers, stacked, repeats: int = 3) -> float:
+    """Single-device baseline: lax.scan fold over the shard dim (the program
+    merge_states_batched compiles)."""
+
+    @jax.jit
+    def fold(stacked):
+        out = []
+        for a, tree in zip(analyzers, stacked):
+            first = jax.tree_util.tree_map(lambda x: x[0], tree)
+            rest = jax.tree_util.tree_map(lambda x: x[1:], tree)
+            out.append(
+                jax.lax.scan(lambda acc, s, _a=a: (_a.merge(acc, s), None), first, rest)[0]
+            )
+        return tuple(out)
+
+    jax.block_until_ready(fold(stacked))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fold(stacked)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def state_bytes(stacked) -> int:
+    return sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(stacked))
+
+
+def time_ingest(analyzers, mesh, n_chunks: int = 5, chunk: int = 32) -> float:
+    """sharded_ingest_fold over n_chunks chunks of chunk host partials."""
+    from deequ_tpu.parallel import sharded_ingest_fold, stack_identity_states
+
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    partials = build_shard_states(analyzers, chunk)
+    flags = np.ones(chunk, dtype=bool)
+    states = stack_identity_states(analyzers, n_dev)
+    # compile
+    states = sharded_ingest_fold(analyzers, mesh, states, partials, flags)
+    jax.block_until_ready(states)
+    t0 = time.perf_counter()
+    for _ in range(n_chunks - 1):
+        states = sharded_ingest_fold(analyzers, mesh, states, partials, flags)
+    jax.block_until_ready(states)
+    return (time.perf_counter() - t0) / (n_chunks - 1)
+
+
+def main() -> None:
+    from deequ_tpu.parallel import make_mesh
+
+    analyzers = battery()
+    devices = jax.devices()
+    print(f"{len(devices)} virtual devices, 27-analyzer battery")
+
+    for n_shards in (8, 32, 96):
+        stacked = build_shard_states(analyzers, n_shards)
+        nbytes = state_bytes(stacked)
+        seq = time_sequential_fold(analyzers, stacked)
+        row = [f"shards={n_shards:4d} ({nbytes/1e6:6.1f}MB)  seq-fold {seq*1e3:7.1f}ms"]
+        for n_dev in (2, 4, 8, 6):
+            mesh = make_mesh(n_dev)
+            t = time_merge(analyzers, mesh, stacked)
+            kind = "butterfly" if (n_dev & (n_dev - 1)) == 0 else "all-gather"
+            row.append(f"{n_dev}dev[{kind}] {t*1e3:7.1f}ms")
+        print("  ".join(row))
+
+    chunk = 32
+    t1 = time_ingest(analyzers, make_mesh(1), chunk=chunk)
+    t8 = time_ingest(analyzers, make_mesh(8), chunk=chunk)
+    print(
+        f"ingest-fold {chunk}-partial chunk: 1dev {t1*1e3:.1f}ms  8dev {t8*1e3:.1f}ms "
+        f"(speedup {t1/t8:.2f}x)"
+    )
+
+
+if __name__ == "__main__":
+    main()
