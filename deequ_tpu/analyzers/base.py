@@ -415,10 +415,17 @@ class HostBatchContext:
         key = ("len", column)
         cached = self._pred_cache.get(key)
         if cached is None:
-            from ..runners.features import string_lengths
+            from ..runners.features import (
+                _is_string_dict,
+                dict_string_lengths,
+                string_lengths,
+            )
 
             col = self.batch.column(column)
-            cached = string_lengths(col.string_source, col.mask)
+            if _is_string_dict(col):
+                cached = dict_string_lengths(col)
+            else:
+                cached = string_lengths(col.string_source, col.mask)
             self._pred_cache[key] = cached
         return cached
 
@@ -426,13 +433,20 @@ class HostBatchContext:
         key = ("type", column)
         cached = self._pred_cache.get(key)
         if cached is None:
-            from ..runners.features import classify_type_codes
+            from ..runners.features import (
+                _is_string_dict,
+                classify_type_codes,
+                dict_type_codes,
+            )
 
             from ..data import ColumnKind
 
             col = self.batch.column(column)
-            source = col.string_source if col.kind == ColumnKind.STRING else col.values
-            cached = classify_type_codes(source, col.mask, col.kind)
+            if _is_string_dict(col):
+                cached = dict_type_codes(col)
+            else:
+                source = col.string_source if col.kind == ColumnKind.STRING else col.values
+                cached = classify_type_codes(source, col.mask, col.kind)
             self._pred_cache[key] = cached
         return cached
 

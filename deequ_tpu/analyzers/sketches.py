@@ -98,6 +98,25 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
 
         col = ctx.batch.column(self.column)
         mask = ctx.column_mask(self, self.column)
+        if col.dictionary is not None and col.codes is not None:
+            # dictionary column: hash the DISTINCT values once (cached in
+            # col.aux across batches), then max-scatter only the entries
+            # present in this batch — O(rows) bincount + O(dict) scatter
+            from ..ops.hll import M, hll_features
+            from ..runners.features import dict_entry_hashes
+
+            pairs = col.aux.get("hll_pairs")
+            if pairs is None:
+                # derives from the shared distinct-value hash pass
+                pairs = hll_features(dict_entry_hashes(col))
+                col.aux["hll_pairs"] = pairs
+            num_cats = len(col.dictionary)
+            counts = np.bincount(col.codes[mask], minlength=num_cats + 1)[:num_cats]
+            present = counts > 0
+            regs = np.zeros(M, dtype=np.int32)
+            if num_cats:
+                np.maximum.at(regs, pairs[0][:num_cats][present], pairs[1][:num_cats][present])
+            return ApproxCountDistinctState(regs)
         if col.kind == ColumnKind.STRING:
             src = col.string_source
             if native_block_hll_strings is not None and (

@@ -111,7 +111,9 @@ class Column:
     touches ``values`` never pays per-value object creation (~1.5us/value —
     it dominated wide-table profiles)."""
 
-    __slots__ = ("name", "kind", "_values", "mask", "codes", "dictionary", "arrow")
+    __slots__ = (
+        "name", "kind", "_values", "mask", "codes", "dictionary", "arrow", "aux"
+    )
 
     def __init__(
         self,
@@ -122,6 +124,7 @@ class Column:
         codes: "Optional[np.ndarray]" = None,
         dictionary: "Optional[np.ndarray]" = None,
         arrow: "Optional[pa.Array]" = None,
+        aux: "Optional[dict]" = None,
     ):
         self.name = name
         self.kind = kind
@@ -130,14 +133,30 @@ class Column:
         self.codes = codes
         self.dictionary = dictionary
         self.arrow = arrow
+        #: per-dataset-column cache for dictionary-derived artifacts (type
+        #: codes, lengths, hashes of the DISTINCT values) — shared across
+        #: batches so each dictionary is processed once per run, not once
+        #: per batch per consumer
+        self.aux = aux if aux is not None else {}
 
     @property
     def values(self) -> np.ndarray:
         if self._values is None:
-            vals = self.arrow.to_numpy(zero_copy_only=False)
-            if vals.dtype != object:
-                vals = vals.astype(object)
-            self._values = vals
+            if self.dictionary is not None and self.codes is not None:
+                # lazy decode: most consumers read codes/dictionary or the
+                # aux caches; a 10M-row object gather only happens if some
+                # python-level consumer genuinely needs per-row values
+                num_cats = len(self.dictionary)
+                safe = np.where(self.codes < num_cats, self.codes, 0)
+                if num_cats:
+                    self._values = self.dictionary[safe]
+                else:
+                    self._values = np.empty(len(self.codes), dtype=object)
+            else:
+                vals = self.arrow.to_numpy(zero_copy_only=False)
+                if vals.dtype != object:
+                    vals = vals.astype(object)
+                self._values = vals
         return self._values
 
     @values.setter
@@ -227,6 +246,9 @@ class Dataset:
         self._schema = Schema(
             [ColumnSchema(f.name, _kind_of_arrow(f.type), f.nullable) for f in table.schema]
         )
+        #: decoded dictionaries + derived-artifact caches, one per column,
+        #: shared by every batch this dataset yields
+        self._dict_aux: Dict[str, dict] = {}
 
     # -- constructors -------------------------------------------------------
 
@@ -342,7 +364,8 @@ class Dataset:
         else:
             mask = np.ones(n, dtype=bool)
         if isinstance(arr, pa.DictionaryArray):
-            return _materialize_dictionary(name, kind, arr, mask, n)
+            aux = self._dict_aux.setdefault(name, {})
+            return _materialize_dictionary(name, kind, arr, mask, n, aux)
         if kind.is_numeric:
             values = _numeric_buffer_view(arr, n)
             if values is None:
@@ -431,14 +454,26 @@ def _decode_dictionary(dictionary: "pa.Array", kind: ColumnKind) -> np.ndarray:
 
 
 def _materialize_dictionary(
-    name: str, kind: ColumnKind, arr: "pa.DictionaryArray", mask: np.ndarray, n: int
+    name: str,
+    kind: ColumnKind,
+    arr: "pa.DictionaryArray",
+    mask: np.ndarray,
+    n: int,
+    aux: "Optional[dict]" = None,
 ) -> Column:
-    """Decode values AND keep the (unified) codes for the device frequency
-    path. Nulls get the out-of-range code len(dictionary), which the
-    segment_sum scatter drops."""
+    """Keep the (unified) codes + decoded dictionary; per-row values decode
+    LAZILY (most consumers work from codes + per-dictionary caches). Nulls
+    get the out-of-range code len(dictionary), which the segment_sum
+    scatter drops. The dictionary decodes once per dataset via ``aux``."""
     import pyarrow.compute as pc
 
-    dict_vals = _decode_dictionary(arr.dictionary, kind)
+    if aux is None:
+        aux = {}
+    dict_vals = aux.get("values")
+    if dict_vals is None or len(dict_vals) != len(arr.dictionary):
+        dict_vals = _decode_dictionary(arr.dictionary, kind)
+        aux.clear()  # dictionary changed: derived artifacts are stale
+        aux["values"] = dict_vals
     num_cats = len(dict_vals)
     # widen BEFORE filling: the null sentinel num_cats may not fit the
     # dictionary's narrow index type (e.g. int8 indices, 128 categories)
@@ -448,12 +483,9 @@ def _materialize_dictionary(
         ),
         dtype=np.int32,
     )
-    safe = np.where(codes < num_cats, codes, 0)
-    if num_cats:
-        values = dict_vals[safe]
-    else:
-        values = np.empty(n, dtype=dict_vals.dtype)
-    return Column(name, kind, values, mask, codes=codes, dictionary=dict_vals)
+    return Column(
+        name, kind, None, mask, codes=codes, dictionary=dict_vals, aux=aux
+    )
 
 
 def _pad_column(col: Column, size: int) -> Column:
@@ -473,7 +505,13 @@ def _pad_column(col: Column, size: int) -> Column:
         arrow = pa.concat_arrays([col.arrow, pa.nulls(pad, col.arrow.type)])
         return Column(
             col.name, col.kind, None, mask, codes=codes,
-            dictionary=col.dictionary, arrow=arrow,
+            dictionary=col.dictionary, arrow=arrow, aux=col.aux,
+        )
+    if col.dictionary is not None and col._values is None:
+        # dictionary columns stay lazy too: codes already padded above
+        return Column(
+            col.name, col.kind, None, mask, codes=codes,
+            dictionary=col.dictionary, aux=col.aux,
         )
     if col.values.dtype == object:
         values = np.empty(size, dtype=object)

@@ -92,6 +92,12 @@ def sharded_update(analyzers: Sequence[Any], mesh: Mesh):
 
 _SHARDED_INGEST_CACHE: dict = {}
 
+#: jitted collective-merge programs keyed by (analyzers, devices, local
+#: shard count, padded leaf shapes/dtypes); bounded FIFO like the engine's
+#: merge-fold cache
+_COLLECTIVE_MERGE_CACHE: dict = {}
+_COLLECTIVE_MERGE_CACHE_MAX = 64
+
 
 def sharded_ingest_fold(
     analyzers: Sequence[Any], mesh: Mesh, states_stacked, partials_stacked, flags
@@ -211,50 +217,63 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
         padded.append(tree)
     padded = tuple(padded)
 
-    shard_spec = jax.tree_util.tree_map(
-        lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), padded
+    # cache the jitted program: the closure is new per call, so without this
+    # every merge invocation RECOMPILED the whole collective program (tens
+    # of seconds of XLA work for a 27-analyzer battery)
+    shape_sig = tuple(
+        (leaf.shape, np.dtype(leaf.dtype).str)
+        for leaf in jax.tree_util.tree_leaves(padded)
     )
-    pow2 = (n_dev & (n_dev - 1)) == 0
-
-    def merge_program(stacked):
-        out = []
-        for a, tree in zip(analyzers, stacked):
-            # 2) local fold of the k resident shards
-            acc = jax.tree_util.tree_map(lambda x: x[0], tree)
-            for i in range(1, k):
-                acc = a.merge(acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], tree))
-            # 3) cross-device combine
-            if n_dev > 1 and pow2:
-                shift = 1
-                while shift < n_dev:
-                    perm = [(i, i ^ shift) for i in range(n_dev)]
-                    partner = jax.tree_util.tree_map(
-                        lambda x: jax.lax.ppermute(x, ROW_AXIS, perm), acc
-                    )
-                    acc = a.merge(acc, partner)
-                    shift <<= 1
-            elif n_dev > 1:
-                gathered = jax.tree_util.tree_map(
-                    lambda x: jax.lax.all_gather(x, ROW_AXIS), acc
-                )
-                acc = jax.tree_util.tree_map(lambda x: x[0], gathered)
-                for i in range(1, n_dev):
-                    acc = a.merge(
-                        acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], gathered)
-                    )
-            out.append(jax.tree_util.tree_map(lambda x: x[None], acc))
-        return tuple(out)
-
-    program = jax.shard_map(
-        merge_program,
-        mesh=mesh,
-        in_specs=(shard_spec,),
-        out_specs=jax.tree_util.tree_map(
+    cache_key = (tuple(analyzers), tuple(mesh.devices.flat), k, shape_sig)
+    program = _COLLECTIVE_MERGE_CACHE.get(cache_key)
+    if program is None:
+        shard_spec = jax.tree_util.tree_map(
             lambda x: P(ROW_AXIS, *([None] * (jnp.asarray(x).ndim - 1))), padded
-        ),
-        check_vma=False,
-    )
-    merged = jax.jit(program)(padded)
+        )
+        pow2 = (n_dev & (n_dev - 1)) == 0
+
+        def merge_program(stacked):
+            out = []
+            for a, tree in zip(analyzers, stacked):
+                # 2) local fold of the k resident shards
+                acc = jax.tree_util.tree_map(lambda x: x[0], tree)
+                for i in range(1, k):
+                    acc = a.merge(acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], tree))
+                # 3) cross-device combine
+                if n_dev > 1 and pow2:
+                    shift = 1
+                    while shift < n_dev:
+                        perm = [(i, i ^ shift) for i in range(n_dev)]
+                        partner = jax.tree_util.tree_map(
+                            lambda x: jax.lax.ppermute(x, ROW_AXIS, perm), acc
+                        )
+                        acc = a.merge(acc, partner)
+                        shift <<= 1
+                elif n_dev > 1:
+                    gathered = jax.tree_util.tree_map(
+                        lambda x: jax.lax.all_gather(x, ROW_AXIS), acc
+                    )
+                    acc = jax.tree_util.tree_map(lambda x: x[0], gathered)
+                    for i in range(1, n_dev):
+                        acc = a.merge(
+                            acc, jax.tree_util.tree_map(lambda x, _i=i: x[_i], gathered)
+                        )
+                out.append(jax.tree_util.tree_map(lambda x: x[None], acc))
+            return tuple(out)
+
+        program = jax.jit(
+            jax.shard_map(
+                merge_program,
+                mesh=mesh,
+                in_specs=(shard_spec,),
+                out_specs=shard_spec,
+                check_vma=False,
+            )
+        )
+        if len(_COLLECTIVE_MERGE_CACHE) >= _COLLECTIVE_MERGE_CACHE_MAX:
+            _COLLECTIVE_MERGE_CACHE.pop(next(iter(_COLLECTIVE_MERGE_CACHE)))
+        _COLLECTIVE_MERGE_CACHE[cache_key] = program
+    merged = program(padded)
     # every device holds the identical full merge; take device 0's copy
     return tuple(
         jax.tree_util.tree_map(lambda x: x[0], tree) for tree in merged

@@ -30,6 +30,9 @@ def _hll_packed(col) -> np.ndarray:
     from ..native import native_hll_pack_numeric, native_hll_pack_strings
     from ..ops.hashing import DEFAULT_SEED
 
+    if _is_string_dict(col):
+        # hash the DISTINCT values once per dataset, gather per row
+        return hll_pack_features(dict_hashes(col), col.mask)
     if col.kind == ColumnKind.STRING:
         if native_hll_pack_strings is not None:
             src = col.string_source
@@ -138,6 +141,65 @@ def regex_matches(values: np.ndarray, mask: np.ndarray, pattern: str) -> np.ndar
     return out
 
 
+def dict_type_codes(col) -> np.ndarray:
+    """Per-row type codes for a dictionary STRING column: classify the
+    DISTINCT values once (cached in col.aux across batches), gather by
+    code. Null/padding rows -> TYPE_NULL."""
+    tc = col.aux.get("type_codes")
+    if tc is None:
+        ones = np.ones(len(col.dictionary), dtype=bool)
+        tc = classify_type_codes(col.dictionary, ones, ColumnKind.STRING)
+        col.aux["type_codes"] = tc
+    num_cats = len(col.dictionary)
+    safe = np.where(col.codes < num_cats, col.codes, 0)
+    out = tc[safe] if num_cats else np.zeros(len(col.codes), dtype=np.int32)
+    out = np.where(col.mask, out, TYPE_NULL).astype(np.int32)
+    return out
+
+
+def dict_string_lengths(col) -> np.ndarray:
+    ld = col.aux.get("lengths")
+    if ld is None:
+        ones = np.ones(len(col.dictionary), dtype=bool)
+        ld = string_lengths(col.dictionary, ones)
+        col.aux["lengths"] = ld
+    num_cats = len(col.dictionary)
+    safe = np.where(col.codes < num_cats, col.codes, 0)
+    out = ld[safe] if num_cats else np.zeros(len(col.codes), dtype=np.int32)
+    return np.where(col.mask, out, 0).astype(np.int32)
+
+
+def dict_entry_hashes(col) -> np.ndarray:
+    """xxhash64 of each DISTINCT dictionary value, cached per dataset —
+    the one hash pass every dictionary consumer (per-row hashes, HLL
+    register pairs) derives from."""
+    hd = col.aux.get("hashes")
+    if hd is None:
+        ones = np.ones(len(col.dictionary), dtype=bool)
+        hd = hash_column(col.dictionary, ones, col.kind)
+        col.aux["hashes"] = hd
+    return hd
+
+
+def dict_hashes(col) -> np.ndarray:
+    """Per-row xxhash64 via the cached distinct-value hashes + a gather.
+    Masked rows carry arbitrary hashes — every consumer masks before use."""
+    hd = dict_entry_hashes(col)
+    num_cats = len(col.dictionary)
+    if not num_cats:
+        return np.zeros(len(col.codes), dtype=np.uint64)
+    safe = np.where(col.codes < num_cats, col.codes, 0)
+    return hd[safe]
+
+
+def _is_string_dict(col) -> bool:
+    return (
+        col.dictionary is not None
+        and col.codes is not None
+        and col.kind == ColumnKind.STRING
+    )
+
+
 class FeatureBuilder:
     """Computes the union of requested features for each batch."""
 
@@ -175,17 +237,23 @@ class FeatureBuilder:
                 features[key] = col.mask
             elif spec.kind == "len":
                 col = batch.column(spec.column)
-                features[key] = string_lengths(col.string_source, col.mask)
+                if _is_string_dict(col):
+                    features[key] = dict_string_lengths(col)
+                else:
+                    features[key] = string_lengths(col.string_source, col.mask)
             elif spec.kind == "match":
                 col = batch.column(spec.column)
                 features[key] = regex_matches(col.values, col.mask, spec.payload)
             elif spec.kind == "type":
                 col = batch.column(spec.column)
-                features[key] = classify_type_codes(
-                    col.string_source if col.kind == ColumnKind.STRING else col.values,
-                    col.mask,
-                    col.kind,
-                )
+                if _is_string_dict(col):
+                    features[key] = dict_type_codes(col)
+                else:
+                    features[key] = classify_type_codes(
+                        col.string_source if col.kind == ColumnKind.STRING else col.values,
+                        col.mask,
+                        col.kind,
+                    )
             elif spec.kind == "hash":
                 col = batch.column(spec.column)
                 features[key] = hash_column(col.values, col.mask, col.kind)

@@ -306,3 +306,60 @@ class TestFrequencySpill:
         )
         assert ctx.metric(CountDistinct(["count"])).value.get() == 3000.0
         assert ctx.metric(Uniqueness(["count"])).value.get() == pytest.approx(1000 / 5000)
+
+
+class TestDictionaryFastPaths:
+    """Dictionary-derived feature caches (type codes, lengths, hashes of
+    DISTINCT values + per-row gathers) must give metrics identical to the
+    plain-column paths, on both ingest tiers."""
+
+    def _battery(self):
+        from deequ_tpu.analyzers import (
+            ApproxCountDistinct,
+            Completeness,
+            DataType,
+            MaxLength,
+            MinLength,
+        )
+
+        return [
+            Completeness("c"), ApproxCountDistinct("c"), DataType("c"),
+            MinLength("c"), MaxLength("c"),
+        ]
+
+    @pytest.mark.parametrize("placement", ["host", "device"])
+    def test_dictionary_matches_plain(self, placement):
+        rng = np.random.default_rng(17)
+        pool = [f"value-{i:04d}"[: 4 + i % 7] for i in range(500)] + ["123", "4.5", "true"]
+        values = [pool[i] for i in rng.integers(0, len(pool), 30_000)]
+        values[::41] = [None] * len(values[::41])
+        plain = Dataset.from_dict({"c": values})
+        encoded = Dataset.from_arrow(
+            pa.table({"c": pa.array(values).dictionary_encode()})
+        )
+        battery = self._battery()
+        ctx_p = AnalysisRunner.do_analysis_run(plain, battery, placement=placement,
+                                               batch_size=4096)
+        ctx_e = AnalysisRunner.do_analysis_run(encoded, battery, placement=placement,
+                                               batch_size=4096)
+        for a in battery:
+            got, want = ctx_e.metric(a).value.get(), ctx_p.metric(a).value.get()
+            if isinstance(want, float):
+                assert got == want, a
+            else:  # DataType histogram distribution
+                assert {k: v.absolute for k, v in got.values.items()} == {
+                    k: v.absolute for k, v in want.values.items()
+                }, a
+
+    def test_dictionary_decoded_once_per_dataset(self):
+        """The dictionary decodes and classifies once per dataset, not once
+        per batch: aux caches are shared across batches."""
+        from deequ_tpu.analyzers import DataType
+
+        values = pa.array([f"v{i % 50}" for i in range(20_000)]).dictionary_encode()
+        data = Dataset.from_arrow(pa.table({"c": values}))
+        AnalysisRunner.do_analysis_run(
+            data, [DataType("c")], placement="host", batch_size=1024
+        )
+        aux = data._dict_aux["c"]
+        assert "values" in aux and "type_codes" in aux
