@@ -240,19 +240,60 @@ def run_profile_stage(rows: int) -> dict:
                 log(f"PARITY MISMATCH {name}: got={got} want={want}")
                 sys.exit(1)
 
-    # single-core pandas oracle on a capped subsample; compare RATES
+    # single-core pandas oracle on a capped subsample; compare RATES. It
+    # must do the same WORK the profiler does per reference semantics:
+    # completeness, approx-distinct, the numeric battery incl. quantiles
+    # (integers are Integral-typed numerics), value histograms for low-card
+    # columns, and per-value regex TYPE INFERENCE on string columns
+    # (`profiles/ColumnProfiler.scala:122-139` pass 1 runs the DataType
+    # classifier over every string value). Categorical (dictionary) columns
+    # classify their categories only — the same advantage our engine takes.
+    from deequ_tpu.runners.features import (
+        _BOOLEAN_RE,
+        _FRACTIONAL_RE,
+        _INTEGRAL_RE,
+    )
+
+    def classify_series(s):
+        if isinstance(s.dtype, pd.CategoricalDtype):
+            cats = pd.Series(s.cat.categories.astype(object))
+            cls = np.select(
+                [
+                    cats.str.fullmatch(_FRACTIONAL_RE.pattern),
+                    cats.str.fullmatch(_INTEGRAL_RE.pattern),
+                    cats.str.fullmatch(_BOOLEAN_RE.pattern),
+                ],
+                [1, 2, 3],
+                default=4,
+            )
+            np.bincount(cls[s.cat.codes[s.cat.codes >= 0]], minlength=5)
+            return
+        sv = s.dropna()  # already str-typed; no re-stringification in the timed region
+        cls = np.select(
+            [
+                sv.str.fullmatch(_FRACTIONAL_RE.pattern),
+                sv.str.fullmatch(_INTEGRAL_RE.pattern),
+                sv.str.fullmatch(_BOOLEAN_RE.pattern),
+            ],
+            [1, 2, 3],
+            default=4,
+        )
+        np.bincount(cls, minlength=5)
+
     oracle_rows = min(rows, ORACLE_ROWS_CAP)
     df = table.slice(0, oracle_rows).to_pandas()
+    import pandas as pd
+
     t0 = time.perf_counter()
     for name in df.columns:
         s = df[name]
         s.notna().mean()
         nunique = s.nunique()
         if s.dtype.kind in "if":
-            # the profiler computes the numeric battery for integer columns
-            # too (they are Integral-typed), so the oracle must as well
             s.mean(); s.min(); s.max(); s.std(ddof=0); s.sum()
             np.nanquantile(s.to_numpy(dtype=np.float64), np.linspace(0.01, 1, 100))
+        elif s.dtype == object or isinstance(s.dtype, pd.CategoricalDtype):
+            classify_series(s)
         if nunique <= 120:
             s.value_counts()
     base_s = time.perf_counter() - t0

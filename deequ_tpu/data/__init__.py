@@ -475,14 +475,20 @@ def _materialize_dictionary(
         aux.clear()  # dictionary changed: derived artifacts are stale
         aux["values"] = dict_vals
     num_cats = len(dict_vals)
-    # widen BEFORE filling: the null sentinel num_cats may not fit the
-    # dictionary's narrow index type (e.g. int8 indices, 128 categories)
-    codes = np.asarray(
-        pc.fill_null(arr.indices.cast(pa.int32()), num_cats).to_numpy(
-            zero_copy_only=False
-        ),
-        dtype=np.int32,
-    )
+    indices = arr.indices
+    if indices.null_count == 0 and indices.type == pa.int32():
+        # the common fast shape (int32 indices, no nulls): zero-copy view,
+        # no per-batch cast/fill pass
+        codes = np.asarray(indices.to_numpy(zero_copy_only=True), dtype=np.int32)
+    else:
+        # widen BEFORE filling: the null sentinel num_cats may not fit the
+        # dictionary's narrow index type (e.g. int8 indices, 128 categories)
+        codes = np.asarray(
+            pc.fill_null(indices.cast(pa.int32()), num_cats).to_numpy(
+                zero_copy_only=False
+            ),
+            dtype=np.int32,
+        )
     return Column(
         name, kind, None, mask, codes=codes, dictionary=dict_vals, aux=aux
     )
