@@ -259,9 +259,9 @@ def run_profile_stage(rows: int) -> dict:
             cats = pd.Series(s.cat.categories.astype(object))
             cls = np.select(
                 [
-                    cats.str.fullmatch(_FRACTIONAL_RE.pattern),
-                    cats.str.fullmatch(_INTEGRAL_RE.pattern),
-                    cats.str.fullmatch(_BOOLEAN_RE.pattern),
+                    cats.str.fullmatch(_FRACTIONAL_RE),
+                    cats.str.fullmatch(_INTEGRAL_RE),
+                    cats.str.fullmatch(_BOOLEAN_RE),
                 ],
                 [1, 2, 3],
                 default=4,
@@ -271,9 +271,9 @@ def run_profile_stage(rows: int) -> dict:
         sv = s.dropna()  # already str-typed; no re-stringification in the timed region
         cls = np.select(
             [
-                sv.str.fullmatch(_FRACTIONAL_RE.pattern),
-                sv.str.fullmatch(_INTEGRAL_RE.pattern),
-                sv.str.fullmatch(_BOOLEAN_RE.pattern),
+                sv.str.fullmatch(_FRACTIONAL_RE),
+                sv.str.fullmatch(_INTEGRAL_RE),
+                sv.str.fullmatch(_BOOLEAN_RE),
             ],
             [1, 2, 3],
             default=4,
