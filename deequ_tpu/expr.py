@@ -23,6 +23,7 @@ comparison against a null value yields False.
 from __future__ import annotations
 
 import ast
+import functools as _functools
 import re
 from typing import Callable, Dict, Union
 
@@ -273,10 +274,11 @@ class _Evaluator(ast.NodeVisitor):
         return [self.visit(e) for e in node.elts]
 
 
-#: parsed predicate ASTs keyed by source string (bounded FIFO): predicates
-#: re-evaluate once per batch per pass, and ast.parse is pure
-_PARSE_CACHE: Dict[str, ast.AST] = {}
-_PARSE_CACHE_MAX = 512
+@_functools.lru_cache(maxsize=512)
+def _parse_predicate(src: str) -> ast.AST:
+    """Predicates re-evaluate once per batch per pass; ast.parse is pure,
+    so the parses cache (thread-safe via lru_cache)."""
+    return ast.parse(src, mode="eval")
 
 
 def evaluate_predicate(predicate: Predicate, columns: Dict[str, np.ndarray], n: int) -> np.ndarray:
@@ -289,18 +291,7 @@ def evaluate_predicate(predicate: Predicate, columns: Dict[str, np.ndarray], n: 
     if callable(predicate):
         result = predicate(columns)
     else:
-        tree = _PARSE_CACHE.get(predicate)
-        if tree is None:
-            tree = ast.parse(predicate, mode="eval")
-            if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
-                # host-tier worker threads evaluate predicates concurrently:
-                # tolerate a racing eviction instead of raising
-                try:
-                    _PARSE_CACHE.pop(next(iter(_PARSE_CACHE)), None)
-                except (StopIteration, RuntimeError):
-                    pass
-            _PARSE_CACHE[predicate] = tree
-        result = _Evaluator(columns).visit(tree)
+        result = _Evaluator(columns).visit(_parse_predicate(predicate))
     mask = _as_bool(result)
     if mask.shape == ():
         mask = np.full(n, bool(mask))

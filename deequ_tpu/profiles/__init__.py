@@ -525,6 +525,7 @@ class ColumnProfilerRunBuilder:
             sharding=self._sharding,
         )
         if self._profiles_path is not None:
-            with open(self._profiles_path, "w") as f:
-                f.write(profiles.to_json())
+            from .. import io as dio
+
+            dio.write_text_atomic(self._profiles_path, profiles.to_json())
         return profiles

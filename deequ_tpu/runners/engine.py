@@ -288,7 +288,9 @@ def probe_feed_bandwidth() -> float:
     stall from silently flipping every later auto-placement decision."""
     global _FEED_BANDWIDTH_MBPS
     if _FEED_BANDWIDTH_MBPS is None:
-        arr = np.zeros(1 << 19, dtype=np.float64)  # 4 MB
+        # 1MB: big enough that fixed latency cannot mimic a slow link,
+        # small enough that probing a 6MB/s tunnel costs ~1s, not ~5s
+        arr = np.zeros(1 << 17, dtype=np.float64)
         import time
 
         np.asarray(jax.device_put(arr))  # untimed warm-up

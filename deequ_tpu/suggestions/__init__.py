@@ -122,9 +122,10 @@ class ConstraintSuggestionRunner:
                 if rule.should_be_applied(profile, profiles.num_records):
                     suggestions.append(rule.candidate(profile, profiles.num_records))
 
+        from .. import io as dio
+
         if profiles_path is not None:
-            with open(profiles_path, "w") as f:
-                f.write(profiles.to_json())
+            dio.write_text_atomic(profiles_path, profiles.to_json())
 
         by_column: Dict[str, List[ConstraintSuggestion]] = {}
         for s in suggestions:
@@ -134,8 +135,7 @@ class ConstraintSuggestionRunner:
             profiles.profiles, profiles.num_records, by_column
         )
         if suggestions_path is not None:
-            with open(suggestions_path, "w") as f:
-                f.write(result.to_json())
+            dio.write_text_atomic(suggestions_path, result.to_json())
 
         # evaluate suggested constraints on the test split
         # (reference `evaluateConstraintsIfNecessary`)
@@ -165,8 +165,7 @@ class ConstraintSuggestionRunner:
                         for s, status in zip(suggestions, statuses)
                     ]
                 }
-                with open(evaluation_path, "w") as f:
-                    f.write(json.dumps(payload, indent=2))
+                dio.write_text_atomic(evaluation_path, json.dumps(payload, indent=2))
         return result
 
 

@@ -262,12 +262,18 @@ class VerificationRunBuilder:
             monitor=self._monitor,
             sharding=self._sharding,
         )
+        # URI-aware sinks (reference writes these through Hadoop FileSystem,
+        # `VerificationSuite.scala:146-172` / `io/DfsUtils.scala:24-85`)
+        from . import io as dio
+
         if self._check_results_path is not None:
-            with open(self._check_results_path, "w") as f:
-                f.write(result.check_results_as_json())
+            dio.write_text_atomic(
+                self._check_results_path, result.check_results_as_json()
+            )
         if self._success_metrics_path is not None:
-            with open(self._success_metrics_path, "w") as f:
-                f.write(result.success_metrics_as_json())
+            dio.write_text_atomic(
+                self._success_metrics_path, result.success_metrics_as_json()
+            )
         return result
 
 

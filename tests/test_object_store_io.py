@@ -150,3 +150,44 @@ class TestLocalPathsUnchanged:
         )
         assert sp.load(a) is not None
         assert repo.load_by_key(ResultKey(1, {})) is not None
+
+
+class TestJsonSinksObjectStore:
+    def test_verification_sinks_accept_uris(self, data):
+        from deequ_tpu import io as dio
+        from deequ_tpu.checks import Check, CheckLevel
+        from deequ_tpu.verification import VerificationSuite
+
+        (
+            VerificationSuite.on_data(data)
+            .add_check(Check(CheckLevel.ERROR, "c").has_size(lambda n: n == 1000))
+            .save_check_results_json_to_path("memory://out/checks.json")
+            .save_success_metrics_json_to_path("memory://out/metrics.json")
+            .run()
+        )
+        import json as _json
+
+        with dio.open_file("memory://out/checks.json", "r") as f:
+            assert _json.loads(f.read())
+        with dio.open_file("memory://out/metrics.json", "r") as f:
+            assert _json.loads(f.read())
+
+    def test_profile_and_suggestion_sinks_accept_uris(self, data):
+        from deequ_tpu import io as dio
+        from deequ_tpu.profiles import ColumnProfilerRunner
+        from deequ_tpu.suggestions import ConstraintSuggestionRunner, Rules
+
+        ColumnProfilerRunner.on_data(data).save_column_profiles_json_to_path(
+            "memory://out/profiles.json"
+        ).run()
+        (
+            ConstraintSuggestionRunner.on_data(data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .save_constraint_suggestions_json_to_path("memory://out/sugg.json")
+            .run()
+        )
+        import json as _json
+
+        for p in ("memory://out/profiles.json", "memory://out/sugg.json"):
+            with dio.open_file(p, "r") as f:
+                assert _json.loads(f.read()), p
