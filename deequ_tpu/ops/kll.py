@@ -105,32 +105,47 @@ def kll_init(sketch_size: int = DEFAULT_SKETCH_SIZE, levels: int = MAX_LEVELS) -
 def _append_level(
     items: jnp.ndarray, sizes: jnp.ndarray, level, values: jnp.ndarray, num_valid
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter-append the valid prefix of ``values`` to ``items[level]``.
-    Writes past capacity drop AND are excluded from the size accounting, so
-    a saturated top level (only reachable past ~1e13 rows) loses weight
-    instead of corrupting the buffer with counted padding."""
+    """Append the valid prefix of ``values`` to ``items[level]``. Writes past
+    capacity drop AND are excluded from the size accounting, so a saturated
+    top level (only reachable past ~1e13 rows) loses weight instead of
+    corrupting the buffer with counted padding.
+
+    Implemented as a GATHER-based row rebuild + one dynamic row update: a
+    TPU scatter of even 2k elements lowers to a serialized loop measured at
+    ~9ms per call — the single hottest op in the old KLL update — while the
+    equivalent ``values[j - size]`` gather + full-row select runs in the
+    same fused elementwise pass as everything else."""
     buf_len = items.shape[1]
-    written = jnp.clip(num_valid.astype(jnp.int32), 0, buf_len - sizes[level])
-    slots = jnp.arange(values.shape[0], dtype=jnp.int32)
-    cols = jnp.where(slots < written, sizes[level] + slots, buf_len)
-    items = items.at[level, cols].set(values, mode="drop")
+    level = jnp.asarray(level, jnp.int32)
+    size = sizes[level]
+    written = jnp.clip(num_valid.astype(jnp.int32), 0, buf_len - size)
+    row = items[level]
+    # shifted[j] = values[j - size]: a dynamic_slice of the padded values
+    # (contiguous window), not a gather — indices are consecutive. INF pads
+    # BOTH sides so the start index (buf_len - size, in [0, buf_len]) is
+    # never clamped regardless of len(values); out-of-range reads yield INF
+    # and are masked by ``take`` anyway.
+    pad = jnp.full(buf_len, _INF, values.dtype)
+    padded = jnp.concatenate([pad, values, pad])
+    shifted = jax.lax.dynamic_slice(padded, (buf_len - size,), (buf_len,))
+    rel = jnp.arange(buf_len, dtype=jnp.int32) - size
+    take = (rel >= 0) & (rel < written)
+    new_row = jnp.where(take, shifted, row)
+    items = jax.lax.dynamic_update_slice(
+        items, new_row[None, :], (level, jnp.zeros((), jnp.int32))
+    )
     sizes = sizes.at[level].add(written)
     return items, sizes
 
 
-def _compact_cascade(items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray, k: int):
-    """One upward sweep: any level holding more than ``k`` items is sorted,
-    every-2nd item of its even-length prefix is promoted to the next level
-    with doubled weight, the odd tail stays (the batched analog of the
-    reference compactor, `analyzers/NonSampleCompactor.scala:29-69`).
-
-    Each level is wrapped in a ``lax.cond``: in a typical fold only the one
-    level that just received an append can overflow, so the other ~31
-    levels skip their sort entirely — this is what makes the chunked ingest
-    fold (32 scan steps x many sketches) cheap. Untouched levels keep their
+def _make_compact_level(shape: Tuple[int, int]):
+    """The single-level compactor: sort the level, promote every-2nd item of
+    its even-length prefix to the next level with doubled weight, keep the
+    odd tail (the batched analog of the reference compactor,
+    `analyzers/NonSampleCompactor.scala:29-69`). Untouched levels keep their
     insertion order; every consumer (compaction itself, HostKLL,
     compactor_buffers) sorts, so only the multiset per level matters."""
-    levels, buf_len = items.shape
+    levels, buf_len = shape
     half = buf_len // 2  # max items a compaction can emit
     slots = jnp.arange(half, dtype=jnp.int32)
     buf_slots = jnp.arange(buf_len, dtype=jnp.int32)
@@ -155,6 +170,16 @@ def _compact_cascade(items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray
         items, sizes = _append_level(items, sizes, lvl + 1, emitted, m_emit)
         return items, sizes, parity
 
+    return compact_level
+
+
+def _compact_cascade(items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray, k: int):
+    """Full upward sweep over every level — needed after a MERGE, where all
+    levels receive appends. Each level is wrapped in a ``lax.cond`` so
+    levels within capacity skip their sort."""
+    levels, _ = items.shape
+    compact_level = _make_compact_level(items.shape)
+
     def body(lvl, carry):
         _items, _sizes, _parity = carry
         return jax.lax.cond(
@@ -168,6 +193,35 @@ def _compact_cascade(items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray
     # upward sweep suffices because level l+1 is processed after receiving
     # level l's promotions
     return jax.lax.fori_loop(0, levels - 1, body, (items, sizes, parity))
+
+
+def _compact_cascade_from(
+    items: jnp.ndarray, sizes: jnp.ndarray, parity: jnp.ndarray, k: int, start_level
+):
+    """Early-terminating cascade for a SINGLE-LEVEL append (batch update /
+    sampled ingest): only ``start_level`` can overflow, each compaction can
+    only overflow the level above, and the cascade dies the moment a level
+    fits — so a ``while_loop`` starting at ``start_level`` visits the one or
+    two levels that actually changed instead of sweeping all ~32 (measured
+    ~3x faster per 1M-row fold than the full sweep on TPU; the sweep's 31
+    ``cond``s each carry the 1MB item buffer through an iteration even when
+    they skip)."""
+    levels, _ = items.shape
+    compact_level = _make_compact_level(items.shape)
+
+    def cond(carry):
+        _items, _sizes, _parity, lvl = carry
+        return (lvl < levels - 1) & (_sizes[lvl] > k)
+
+    def body(carry):
+        _items, _sizes, _parity, lvl = carry
+        _items, _sizes, _parity = compact_level(lvl, (_items, _sizes, _parity))
+        return _items, _sizes, _parity, lvl + 1
+
+    items, sizes, parity, _ = jax.lax.while_loop(
+        cond, body, (items, sizes, parity, jnp.asarray(start_level, jnp.int32))
+    )
+    return items, sizes, parity
 
 
 def kll_update(state: KLLSketchState, values: jnp.ndarray, valid: jnp.ndarray) -> KLLSketchState:
@@ -207,7 +261,7 @@ def kll_update(state: KLLSketchState, values: jnp.ndarray, valid: jnp.ndarray) -
     m = jnp.sum(sample_valid).astype(jnp.int32)
 
     items, sizes = _append_level(state.items, state.sizes, h, samples, m)
-    items, sizes, parity = _compact_cascade(items, sizes, state.parity, k)
+    items, sizes, parity = _compact_cascade_from(items, sizes, state.parity, k, h)
 
     return KLLSketchState(
         items=items,
@@ -251,7 +305,7 @@ def kll_ingest_sampled(
         state.items, state.sizes, jnp.asarray(h, dtype=jnp.int32), sv,
         jnp.asarray(m, dtype=jnp.int32),
     )
-    items, sizes, parity = _compact_cascade(items, sizes, state.parity, k)
+    items, sizes, parity = _compact_cascade_from(items, sizes, state.parity, k, h)
     return KLLSketchState(
         items=items,
         sizes=sizes,
