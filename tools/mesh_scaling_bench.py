@@ -86,7 +86,7 @@ def build_shard_states(analyzers, n_shards: int, rows_per_shard: int = 1 << 12):
         ):
             break
         features = engine._prepare(batch)
-        states = program(tuple(a.init_state() for a in analyzers), features)
+        states = program.unpack(program(program.init_carry(), features))
         per_shard.append(states)
     stacked = tuple(
         jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *[p[i] for p in per_shard])
