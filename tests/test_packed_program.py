@@ -1,0 +1,131 @@
+"""PackedScanProgram: the packed-carry fused scan (engine.py).
+
+Pins the round-4 fusion-root redesign: all scalar state leaves ride ONE
+stacked float vector + ONE stacked int vector through the per-batch device
+program (XLA fuses sibling reductions only when they share an output root —
+with per-analyzer scalar carries each reduction recomputed a full pass over
+the batch). These tests freeze the contract the speedup rests on:
+
+- pack/unpack is a lossless bijection for every state type in the battery;
+- the packed chain computes bit-identical states to folding each analyzer's
+  ``update`` directly;
+- int counters round-trip exactly through the int vector even at magnitudes
+  where a float slot would corrupt them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Correlation,
+    DataType,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners.engine import PackedScanProgram, _fused_program, ScanEngine
+
+
+def battery():
+    return (
+        Size(),
+        Completeness("x"),
+        Mean("x"),
+        Sum("x"),
+        Minimum("x"),
+        Maximum("x"),
+        StandardDeviation("x"),
+        Correlation("x", "y"),
+        DataType("s"),
+        ApproxCountDistinct("y"),
+        KLLSketch("x", KLLParameters(256, 0.64, 10)),
+    )
+
+
+def make_features(engine, rows=512, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, rows)
+    x[rng.random(rows) < 0.1] = np.nan
+    data = Dataset.from_dict(
+        {
+            "x": x,
+            "y": rng.integers(0, 100, rows),
+            "s": np.array(
+                [["12", "ab", "3.5", "true", ""][i % 5] for i in range(rows)],
+                dtype=object,
+            ),
+        }
+    )
+    batch = next(iter(data.batches(rows, columns=engine.required_columns())))
+    return engine._prepare(batch)
+
+
+class TestPackedScanProgram:
+    def test_init_carry_unpacks_to_init_states(self):
+        analyzers = battery()
+        prog = _fused_program(analyzers, None)
+        states = jax.tree_util.tree_map(np.asarray, prog.unpack(prog.init_carry()))
+        for a, s in zip(analyzers, states):
+            ref = a.init_state()
+            for got, want in zip(
+                jax.tree_util.tree_leaves(s), jax.tree_util.tree_leaves(ref)
+            ):
+                got, want = np.asarray(got), np.asarray(want)
+                assert got.dtype == want.dtype, (a.name, got.dtype, want.dtype)
+                assert got.shape == want.shape, (a.name, got.shape, want.shape)
+                np.testing.assert_array_equal(got, want, err_msg=a.name)
+
+    def test_packed_chain_equals_direct_update_fold(self):
+        analyzers = battery()
+        prog = PackedScanProgram(analyzers, None)
+        engine = ScanEngine(list(analyzers), placement="device")
+
+        carry = prog.init_carry()
+        direct = tuple(a.init_state() for a in analyzers)
+        direct_step = jax.jit(
+            lambda sts, f: tuple(
+                a.update(s, f) for a, s in zip(analyzers, sts)
+            )
+        )
+        for seed in range(3):
+            features = make_features(engine, seed=seed)
+            carry = prog(carry, features)
+            direct = direct_step(direct, features)
+        packed_states = jax.tree_util.tree_map(np.asarray, prog.unpack(carry))
+        direct_states = jax.tree_util.tree_map(np.asarray, direct)
+        for a, ps, ds in zip(analyzers, packed_states, direct_states):
+            for got, want in zip(
+                jax.tree_util.tree_leaves(ps), jax.tree_util.tree_leaves(ds)
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(got), np.asarray(want), err_msg=a.name
+                )
+
+    def test_int_counters_round_trip_exactly_at_large_magnitudes(self):
+        # 2^40 + 3 is representable in int64/f64 but NOT in f32 — a float
+        # slot in 32-bit mode would corrupt it; the int vector must not
+        analyzers = (Size(),)
+        prog = PackedScanProgram(analyzers, None)
+        big = np.int64((1 << 40) + 3)
+        state = analyzers[0].init_state().__class__(
+            jnp.asarray(big, dtype=jnp.int64)
+        )
+        carry = prog._pack((state,))
+        (roundtrip,) = jax.tree_util.tree_map(np.asarray, prog._unpack(carry))
+        assert int(jax.tree_util.tree_leaves(roundtrip)[0]) == int(big)
+
+    def test_program_cache_returns_same_packed_program(self):
+        analyzers = battery()
+        assert _fused_program(analyzers, None) is _fused_program(analyzers, None)
