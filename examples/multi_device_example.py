@@ -1,0 +1,135 @@
+"""Scale a verification run over a device mesh — the TPU-native capability
+the Scala reference delegates to the Spark cluster (SURVEY.md §2.9: data
+parallelism over row partitions with algebraic state merge).
+
+Three equivalent ways to use many chips, all built on the same semigroup
+state algebra (`analyzers/Analyzer.scala:34-53` in the reference):
+
+1. **Sharded streaming scan** — hand the engine a `jax.sharding.Mesh`; the
+   fused per-batch program row-shards the feature arrays and XLA inserts the
+   cross-device partial-reduce collectives (Spark's partial agg + shuffle,
+   compiled, riding ICI).
+2. **Independent shard scans + collective merge** — run one engine per data
+   shard (e.g. one per host in a pod), then butterfly-merge the per-shard
+   states with `collective_merge_states` (the `rdd.treeReduce` analog,
+   reference `analyzers/runners/KLLRunner.scala:104-112`).
+3. **Persisted states + `run_on_aggregated_states`** — no collective at
+   all: shard states round-trip through a StateProvider (local or
+   object-store URI) and merge offline, exactly like the reference's
+   partitioned-table refresh (`AnalysisRunner.scala:385-460`).
+
+This example runs all three on whatever devices the process sees (the test
+conftest provides an 8-virtual-device CPU mesh; on a TPU pod slice the same
+code uses the real chips) and asserts they produce identical metrics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        KLLParameters,
+        KLLSketch,
+        Mean,
+        Size,
+        StandardDeviation,
+    )
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.parallel import collective_merge_states, make_mesh
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.engine import ScanEngine
+
+    n_devices = min(len(jax.devices()), 8)
+    mesh = make_mesh(n_devices)
+    analyzers = [
+        Size(),
+        Completeness("latency_ms"),
+        Mean("latency_ms"),
+        StandardDeviation("latency_ms"),
+        ApproxCountDistinct("endpoint"),
+        KLLSketch("latency_ms", KLLParameters(256, 0.64, 10)),
+    ]
+
+    rng = np.random.default_rng(0)
+    rows = 4096 * n_devices
+    latency = rng.gamma(2.0, 30.0, rows)
+    endpoint = rng.integers(0, 200, rows)
+    data = Dataset.from_dict({"latency_ms": latency, "endpoint": endpoint})
+
+    # 1) sharded streaming scan: ONE engine over the whole mesh
+    ctx_sharded = AnalysisRunner.do_analysis_run(
+        data, analyzers, batch_size=rows, sharding=mesh, placement="device"
+    )
+
+    # 2) per-shard engines + explicit collective merge
+    shard_rows = rows // n_devices
+    per_shard_states = []
+    for d in range(n_devices):
+        shard = Dataset.from_dict(
+            {
+                "latency_ms": latency[d * shard_rows : (d + 1) * shard_rows],
+                "endpoint": endpoint[d * shard_rows : (d + 1) * shard_rows],
+            }
+        )
+        states, _ = ScanEngine(analyzers, placement="device").run(shard)
+        per_shard_states.append(states)
+    stacked = tuple(
+        jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[s[i] for s in per_shard_states],
+        )
+        for i in range(len(analyzers))
+    )
+    merged = collective_merge_states(analyzers, mesh, stacked)
+    metrics_merged = {
+        a.name: a.compute_metric_from(
+            jax.tree_util.tree_map(np.asarray, jax.device_get(m))
+        ).value.get()
+        for a, m in zip(analyzers, merged)
+        if a.name != "KLLSketch"
+    }
+
+    # 3) offline: persist per-shard states, refresh metrics with no rescan
+    from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+    providers = []
+    for d, states in enumerate(per_shard_states):
+        provider = InMemoryStateProvider()
+        for a, s in zip(analyzers, states):
+            provider.persist(a, jax.tree_util.tree_map(np.asarray, s))
+        providers.append(provider)
+    ctx_offline = AnalysisRunner.run_on_aggregated_states(
+        data.schema, analyzers, providers
+    )
+
+    metrics_sharded = {
+        a.name: m.value.get()
+        for a, m in ctx_sharded.metric_map.items()
+        if a.name != "KLLSketch"
+    }
+    metrics_offline = {
+        a.name: m.value.get()
+        for a, m in ctx_offline.metric_map.items()
+        if a.name != "KLLSketch"
+    }
+    for name, want in metrics_sharded.items():
+        for variant, got_map in (("merged", metrics_merged), ("offline", metrics_offline)):
+            got = got_map[name]
+            assert abs(got - want) <= 1e-9 * max(1.0, abs(want)), (
+                name, variant, got, want,
+            )
+
+    print(f"mesh: {n_devices} devices; all three distribution modes agree:")
+    for name, value in sorted(metrics_sharded.items()):
+        print(f"  {name}: {value:.6g}")
+    return metrics_sharded, metrics_merged, metrics_offline
+
+
+if __name__ == "__main__":
+    main()

@@ -120,3 +120,15 @@ class TestExamples:
         ]
         assert len(failed) == 2
         assert "We found errors" in capsys.readouterr().out
+
+    def test_multi_device_example(self, capsys):
+        import jax
+
+        from examples import multi_device_example
+
+        sharded, merged, offline = multi_device_example.main()
+        # all three distribution modes returned the same metric set
+        assert set(sharded) == set(merged) == set(offline)
+        n_devices = min(len(jax.devices()), 8)
+        assert sharded["Size"] == n_devices * 4096
+        assert "all three distribution modes agree" in capsys.readouterr().out

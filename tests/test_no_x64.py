@@ -1,0 +1,87 @@
+"""32-bit accumulator mode (DEEQU_TPU_NO_X64=1).
+
+The engine's default is f64 accumulators for ±1e-6 Spark parity; the
+documented opt-out (`config.py`) falls back to f32/int32. That mode also
+takes the OTHER branch of the packed-carry int vector (int32 slots — the
+reason floats and ints pack separately, see engine.PackedScanProgram), so
+it needs coverage even though parity-focused CI runs x64. jax pins x64 at
+import time, so the 32-bit run happens in a subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_PROG = r"""
+import os, json
+os.environ["DEEQU_TPU_NO_X64"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct, Completeness, Maximum, Mean, Minimum, Size,
+    StandardDeviation, Sum,
+)
+
+rng = np.random.default_rng(11)
+n = 20_000
+x = rng.normal(50.0, 4.0, n)
+data = Dataset.from_dict({"x": x, "y": rng.integers(0, 500, n)})
+analyzers = [
+    Size(), Completeness("x"), Mean("x"), Sum("x"), Minimum("x"),
+    Maximum("x"), StandardDeviation("x"), ApproxCountDistinct("y"),
+]
+ctx = AnalysisRunner.do_analysis_run(data, analyzers, batch_size=4096,
+                                     placement="device")
+out = {}
+for a, m in ctx.metric_map.items():
+    assert m.value.is_success, (a.name, m.value)
+    out[a.name] = m.value.get()
+out["__oracle_mean__"] = float(x.mean())
+out["__oracle_sum__"] = float(x.sum())
+out["__oracle_std__"] = float(x.std())
+print(json.dumps(out))
+"""
+
+
+class TestNoX64Mode:
+    def test_engine_runs_and_approximates_in_f32(self):
+        env = dict(os.environ)
+        env.pop("DEEQU_TPU_PLACEMENT", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROG],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        vals = json.loads(proc.stdout.strip().splitlines()[-1])
+
+        assert vals["Size"] == 20_000.0
+        assert vals["Completeness"] == 1.0
+        # f32 accumulation over 20k values of magnitude ~50: relative error
+        # bounded by ~sqrt(n)*eps_f32 with batched reduction — 1e-4 is loose
+        for key, want in (
+            ("Mean", vals["__oracle_mean__"]),
+            ("Sum", vals["__oracle_sum__"]),
+            ("StandardDeviation", vals["__oracle_std__"]),
+        ):
+            got = vals[key]
+            assert abs(got - want) <= 1e-4 * max(1.0, abs(want)), (key, got, want)
+        # HLL registers are integer state: estimate must stay in the normal
+        # 5%-relativeSD envelope regardless of accumulator width
+        assert abs(vals["ApproxCountDistinct"] - 500) <= 0.2 * 500
+        # the mode must have ACTUALLY taken effect: an f32-accumulated min
+        # is exactly f32-representable, while under a silently-still-f64
+        # engine the minimum of 20k normal draws is f32-inexact with
+        # near-certainty (P[53-bit value hits a 24-bit grid point] ~ 2^-29)
+        assert vals["Minimum"] == float(np.float32(vals["Minimum"]))
