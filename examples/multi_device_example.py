@@ -67,8 +67,10 @@ def main():
         data, analyzers, batch_size=rows, sharding=mesh, placement="device"
     )
 
-    # 2) per-shard engines + explicit collective merge
+    # 2) per-shard scans + explicit collective merge (ONE engine reused:
+    #    identical analyzers/shapes share the same compiled program)
     shard_rows = rows // n_devices
+    shard_engine = ScanEngine(analyzers, placement="device")
     per_shard_states = []
     for d in range(n_devices):
         shard = Dataset.from_dict(
@@ -77,7 +79,7 @@ def main():
                 "endpoint": endpoint[d * shard_rows : (d + 1) * shard_rows],
             }
         )
-        states, _ = ScanEngine(analyzers, placement="device").run(shard)
+        states, _ = shard_engine.run(shard)
         per_shard_states.append(states)
     stacked = tuple(
         jax.tree_util.tree_map(
@@ -86,14 +88,23 @@ def main():
         )
         for i in range(len(analyzers))
     )
+    # scalar metrics only: the KLL quantile sketch is compared via its own
+    # rank-error tests, not exact equality
+    def scalar_metrics(pairs):
+        return {
+            a.name: value for a, value in pairs if a.name != "KLLSketch"
+        }
+
     merged = collective_merge_states(analyzers, mesh, stacked)
-    metrics_merged = {
-        a.name: a.compute_metric_from(
-            jax.tree_util.tree_map(np.asarray, jax.device_get(m))
-        ).value.get()
+    metrics_merged = scalar_metrics(
+        (
+            a,
+            a.compute_metric_from(
+                jax.tree_util.tree_map(np.asarray, jax.device_get(m))
+            ).value.get(),
+        )
         for a, m in zip(analyzers, merged)
-        if a.name != "KLLSketch"
-    }
+    )
 
     # 3) offline: persist per-shard states, refresh metrics with no rescan
     from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
@@ -108,16 +119,12 @@ def main():
         data.schema, analyzers, providers
     )
 
-    metrics_sharded = {
-        a.name: m.value.get()
-        for a, m in ctx_sharded.metric_map.items()
-        if a.name != "KLLSketch"
-    }
-    metrics_offline = {
-        a.name: m.value.get()
-        for a, m in ctx_offline.metric_map.items()
-        if a.name != "KLLSketch"
-    }
+    metrics_sharded = scalar_metrics(
+        (a, m.value.get()) for a, m in ctx_sharded.metric_map.items()
+    )
+    metrics_offline = scalar_metrics(
+        (a, m.value.get()) for a, m in ctx_offline.metric_map.items()
+    )
     for name, want in metrics_sharded.items():
         for variant, got_map in (("merged", metrics_merged), ("offline", metrics_offline)):
             got = got_map[name]
