@@ -160,20 +160,16 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
         # in VMEM — measured 0.34ms per 1M rows, identical registers.
         # Within one register group the key max IS (idx<<6 | max pw), so
         # the masked-out rows' key 0 (idx 0, pw 0) never wins a max.
+        from ..ops import chunked_key_fold
+
         keys = jnp.where(mask, packed, 0).astype(jnp.int32)
-        chunk = min(4096, keys.shape[0])
-        pad = (-keys.shape[0]) % chunk
-        if pad:
-            keys = jnp.concatenate([keys, jnp.zeros(pad, jnp.int32)])
         regs = jnp.arange(M, dtype=jnp.int32)
 
         def fold_chunk(acc, row):
             hit = (row[:, None] >> 6) == regs[None, :]
-            return jnp.maximum(acc, jnp.max(jnp.where(hit, row[:, None], 0), axis=0)), None
+            return jnp.maximum(acc, jnp.max(jnp.where(hit, row[:, None], 0), axis=0))
 
-        acc, _ = jax.lax.scan(
-            fold_chunk, jnp.zeros(M, jnp.int32), keys.reshape(-1, chunk)
-        )
+        acc = chunked_key_fold(keys, 0, jnp.zeros(M, jnp.int32), fold_chunk)
         batch_regs = (acc & 63).astype(jnp.int32)
         return ApproxCountDistinctState(jnp.maximum(state.registers, batch_regs))
 

@@ -102,8 +102,8 @@ class Column:
 
     Dictionary-encoded sources additionally carry ``codes`` (int32 indices
     into the table-wide unified ``dictionary``; nulls and padding are coded
-    ``len(dictionary)``) so frequency counting can ride the device scan as a
-    ``segment_sum`` instead of a host group-by.
+    ``len(dictionary)``) so frequency counting can ride the device scan
+    (scatter-free, see ``DeviceFrequencyScan``) instead of a host group-by.
 
     String columns keep the Arrow array in ``arrow`` and materialize the
     python-object ``values`` LAZILY: the native kernels (hash, classify,

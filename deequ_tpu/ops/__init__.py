@@ -1,0 +1,28 @@
+"""Device kernels (HLL, KLL, hashing) and shared TPU op scaffolding."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_key_fold(keys, pad_value, init, fold_chunk, chunk: int = 4096):
+    """Fold a 1-D key array through ``fold_chunk`` in fixed-size chunks via
+    ``lax.scan``: the per-chunk broadcast tile (e.g. a ``(chunk, K)``
+    compare against category/register ids) stays in VMEM instead of
+    materializing a ``(rows, K)`` intermediate — the pattern both the HLL
+    register max and the device frequency count use, and the reason neither
+    needs a TPU scatter (which lowers to a serialized loop) or a sort.
+
+    ``keys`` is padded to a chunk multiple with ``pad_value``; callers pick
+    a sentinel their fold ignores. ``fold_chunk(acc, row) -> acc`` folds one
+    ``(chunk,)`` slice.
+    """
+    c = min(chunk, keys.shape[0])
+    pad = (-keys.shape[0]) % c
+    if pad:
+        keys = jnp.concatenate([keys, jnp.full(pad, pad_value, keys.dtype)])
+    acc, _ = jax.lax.scan(
+        lambda a, row: (fold_chunk(a, row), None), init, keys.reshape(-1, c)
+    )
+    return acc

@@ -89,21 +89,19 @@ def main():
         for i in range(len(analyzers))
     )
     # scalar metrics only: the KLL quantile sketch is compared via its own
-    # rank-error tests, not exact equality
-    def scalar_metrics(pairs):
+    # rank-error tests, not exact equality. Filtering happens BEFORE the
+    # value is computed, so excluded metrics are never evaluated.
+    def scalar_metrics(pairs, value_of):
         return {
-            a.name: value for a, value in pairs if a.name != "KLLSketch"
+            a.name: value_of(a, x) for a, x in pairs if a.name != "KLLSketch"
         }
 
     merged = collective_merge_states(analyzers, mesh, stacked)
     metrics_merged = scalar_metrics(
-        (
-            a,
-            a.compute_metric_from(
-                jax.tree_util.tree_map(np.asarray, jax.device_get(m))
-            ).value.get(),
-        )
-        for a, m in zip(analyzers, merged)
+        zip(analyzers, merged),
+        lambda a, m: a.compute_metric_from(
+            jax.tree_util.tree_map(np.asarray, jax.device_get(m))
+        ).value.get(),
     )
 
     # 3) offline: persist per-shard states, refresh metrics with no rescan
@@ -119,12 +117,9 @@ def main():
         data.schema, analyzers, providers
     )
 
-    metrics_sharded = scalar_metrics(
-        (a, m.value.get()) for a, m in ctx_sharded.metric_map.items()
-    )
-    metrics_offline = scalar_metrics(
-        (a, m.value.get()) for a, m in ctx_offline.metric_map.items()
-    )
+    get_value = lambda a, m: m.value.get()  # noqa: E731
+    metrics_sharded = scalar_metrics(ctx_sharded.metric_map.items(), get_value)
+    metrics_offline = scalar_metrics(ctx_offline.metric_map.items(), get_value)
     for name, want in metrics_sharded.items():
         for variant, got_map in (("merged", metrics_merged), ("offline", metrics_offline)):
             got = got_map[name]
