@@ -486,7 +486,6 @@ class DeviceFrequencyScan(ScanShareableAnalyzer):
         return FrequencyCountsState.init(self.num_categories)
 
     def update(self, state, features):
-        import jax
         import jax.numpy as jnp
 
         from .base import codes_feature, mask_feature

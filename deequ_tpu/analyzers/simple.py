@@ -741,7 +741,15 @@ class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
     def update(self, state, features):
         codes = features[typeclass_feature(self.column).key]
         mask = self._row_mask(features)
-        counts = jnp.zeros(5, dtype=COUNT_DTYPE).at[codes].add(mask.astype(COUNT_DTYPE))
+        # five masked sums, not a scatter-add (`.at[codes].add` lowers to a
+        # serialized per-row loop on TPU); the (rows, 5) compare fuses into
+        # the shared elementwise pass
+        classes = jnp.arange(5, dtype=codes.dtype)
+        counts = jnp.sum(
+            (codes[:, None] == classes[None, :]) & mask[:, None],
+            axis=0,
+            dtype=COUNT_DTYPE,
+        )
         return DataTypeHistogram(state.counts + counts)
 
     def merge(self, a, b):

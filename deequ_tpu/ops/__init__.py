@@ -18,6 +18,8 @@ def chunked_key_fold(keys, pad_value, init, fold_chunk, chunk: int = 4096):
     a sentinel their fold ignores. ``fold_chunk(acc, row) -> acc`` folds one
     ``(chunk,)`` slice.
     """
+    if keys.shape[0] == 0:
+        return init
     c = min(chunk, keys.shape[0])
     pad = (-keys.shape[0]) % c
     if pad:

@@ -6,7 +6,8 @@ register updates on a packed 52-long agg buffer (reference
 `analyzers/catalyst/HLLConstants.scala:25-37`). Here the per-row work is
 vectorized: the host turns xxhash64 values into (register-index,
 leading-zero-count) pairs in one numpy pass, the device folds a whole batch
-into the 512-register state with one ``segment_max``, and merge is an
+into the 512-register state with a chunked one-hot compare/max scan
+(scatter-free — see ``ApproxCountDistinct.update``), and merge is an
 elementwise register max — psum-compatible over a mesh axis
 (``jax.lax.pmax``).
 
