@@ -99,7 +99,7 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
 
         col = ctx.batch.column(self.column)
         mask = ctx.column_mask(self, self.column)
-        if col.dictionary is not None and col.codes is not None:
+        if col.has_dictionary and col.codes is not None:
             # dictionary column: hash the DISTINCT values once (cached in
             # col.aux across batches), then max-scatter only the entries
             # present in this batch — O(rows) bincount + O(dict) scatter
@@ -111,7 +111,7 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
                 # derives from the shared distinct-value hash pass
                 pairs = hll_features(dict_entry_hashes(col))
                 col.aux["hll_pairs"] = pairs
-            num_cats = len(col.dictionary)
+            num_cats = col.num_categories
             counts = np.bincount(col.codes[mask], minlength=num_cats + 1)[:num_cats]
             present = counts > 0
             regs = np.zeros(M, dtype=np.int32)

@@ -112,7 +112,8 @@ class Column:
     it dominated wide-table profiles)."""
 
     __slots__ = (
-        "name", "kind", "_values", "mask", "codes", "dictionary", "arrow", "aux"
+        "name", "kind", "_values", "mask", "codes", "_dictionary",
+        "_dictionary_arrow", "arrow", "aux"
     )
 
     def __init__(
@@ -123,6 +124,7 @@ class Column:
         mask: np.ndarray,
         codes: "Optional[np.ndarray]" = None,
         dictionary: "Optional[np.ndarray]" = None,
+        dictionary_arrow: "Optional[pa.Array]" = None,
         arrow: "Optional[pa.Array]" = None,
         aux: "Optional[dict]" = None,
     ):
@@ -131,7 +133,8 @@ class Column:
         self._values = values
         self.mask = mask
         self.codes = codes
-        self.dictionary = dictionary
+        self._dictionary = dictionary
+        self._dictionary_arrow = dictionary_arrow
         self.arrow = arrow
         #: per-dataset-column cache for dictionary-derived artifacts (type
         #: codes, lengths, hashes of the DISTINCT values) — shared across
@@ -140,13 +143,53 @@ class Column:
         self.aux = aux if aux is not None else {}
 
     @property
+    def has_dictionary(self) -> bool:
+        """Dictionary-encoded? Answered WITHOUT decoding (``.dictionary``
+        decodes a large string dictionary to python objects on first touch
+        — ~1s for a TPC-H comment column — so presence checks must not)."""
+        return self._dictionary is not None or self._dictionary_arrow is not None
+
+    @property
+    def num_categories(self) -> "Optional[int]":
+        if self._dictionary is not None:
+            return len(self._dictionary)
+        if self._dictionary_arrow is not None:
+            return len(self._dictionary_arrow)
+        return None
+
+    @property
+    def dictionary_source(self):
+        """The dictionary payload for the native string kernels: the ARROW
+        array when available (buffer-direct, no object materialization).
+        Non-string dictionaries return the decoded numpy array — their
+        consumers (`hash_column`'s numeric paths) need real dtypes, and a
+        numeric decode is a cheap buffer view, not an object explosion."""
+        if self._dictionary_arrow is not None and self.kind == ColumnKind.STRING:
+            return self._dictionary_arrow
+        return self.dictionary
+
+    @property
+    def dictionary(self) -> "Optional[np.ndarray]":
+        """Decoded dictionary values; decodes LAZILY from the arrow payload
+        (cached in ``aux['values']`` across batches). Consumers that only
+        need presence/length/native-kernel input use ``has_dictionary`` /
+        ``num_categories`` / ``dictionary_source`` instead."""
+        if self._dictionary is None and self._dictionary_arrow is not None:
+            vals = self.aux.get("values")
+            if vals is None or len(vals) != len(self._dictionary_arrow):
+                vals = _decode_dictionary(self._dictionary_arrow, self.kind)
+                self.aux["values"] = vals
+            self._dictionary = vals
+        return self._dictionary
+
+    @property
     def values(self) -> np.ndarray:
         if self._values is None:
-            if self.dictionary is not None and self.codes is not None:
+            if self.has_dictionary and self.codes is not None:
                 # lazy decode: most consumers read codes/dictionary or the
                 # aux caches; a 10M-row object gather only happens if some
                 # python-level consumer genuinely needs per-row values
-                num_cats = len(self.dictionary)
+                num_cats = self.num_categories
                 safe = np.where(self.codes < num_cats, self.codes, 0)
                 if num_cats:
                     self._values = self.dictionary[safe]
@@ -461,20 +504,21 @@ def _materialize_dictionary(
     n: int,
     aux: "Optional[dict]" = None,
 ) -> Column:
-    """Keep the (unified) codes + decoded dictionary; per-row values decode
-    LAZILY (most consumers work from codes + per-dictionary caches). Nulls
-    get the out-of-range code len(dictionary), which the segment_sum
-    scatter drops. The dictionary decodes once per dataset via ``aux``."""
+    """Keep the (unified) codes + the ARROW dictionary; BOTH per-row values
+    and the decoded dictionary stay LAZY — decoding a large string
+    dictionary to python objects costs ~1s for a TPC-H comment column, and
+    the native kernels (classify/lengths/hash) read the arrow buffers
+    directly, so a profile run may never need the objects at all. Nulls get
+    the out-of-range code len(dictionary), which the scatter-free device
+    count drops. Derived artifacts cache once per dataset via ``aux``."""
     import pyarrow.compute as pc
 
     if aux is None:
         aux = {}
-    dict_vals = aux.get("values")
-    if dict_vals is None or len(dict_vals) != len(arr.dictionary):
-        dict_vals = _decode_dictionary(arr.dictionary, kind)
+    num_cats = len(arr.dictionary)
+    if aux.get("num_categories") != num_cats:
         aux.clear()  # dictionary changed: derived artifacts are stale
-        aux["values"] = dict_vals
-    num_cats = len(dict_vals)
+        aux["num_categories"] = num_cats
     indices = arr.indices
     if indices.null_count == 0 and indices.type == pa.int32():
         # the common fast shape (int32 indices, no nulls): zero-copy view,
@@ -490,7 +534,8 @@ def _materialize_dictionary(
             dtype=np.int32,
         )
     return Column(
-        name, kind, None, mask, codes=codes, dictionary=dict_vals, aux=aux
+        name, kind, None, mask, codes=codes,
+        dictionary_arrow=arr.dictionary, aux=aux,
     )
 
 
@@ -503,21 +548,23 @@ def _pad_column(col: Column, size: int) -> Column:
     mask[:m] = col.mask
     codes = None
     if col.codes is not None:
-        # padding rows carry the null code (dropped by the scatter)
-        codes = np.full(size, len(col.dictionary), dtype=np.int32)
+        # padding rows carry the null code (dropped by the device count)
+        codes = np.full(size, col.num_categories, dtype=np.int32)
         codes[:m] = col.codes
     if col.arrow is not None and col._values is None:
         # stay lazy: pad the arrow array with nulls (C-speed concat)
         arrow = pa.concat_arrays([col.arrow, pa.nulls(pad, col.arrow.type)])
         return Column(
             col.name, col.kind, None, mask, codes=codes,
-            dictionary=col.dictionary, arrow=arrow, aux=col.aux,
+            dictionary=col._dictionary, dictionary_arrow=col._dictionary_arrow,
+            arrow=arrow, aux=col.aux,
         )
-    if col.dictionary is not None and col._values is None:
+    if col.has_dictionary and col._values is None:
         # dictionary columns stay lazy too: codes already padded above
         return Column(
             col.name, col.kind, None, mask, codes=codes,
-            dictionary=col.dictionary, aux=col.aux,
+            dictionary=col._dictionary, dictionary_arrow=col._dictionary_arrow,
+            aux=col.aux,
         )
     if col.values.dtype == object:
         values = np.empty(size, dtype=object)
@@ -525,4 +572,7 @@ def _pad_column(col: Column, size: int) -> Column:
     else:
         values = np.zeros(size, dtype=col.values.dtype)
         values[:m] = col.values
-    return Column(col.name, col.kind, values, mask, codes=codes, dictionary=col.dictionary)
+    return Column(
+        col.name, col.kind, values, mask, codes=codes,
+        dictionary=col._dictionary, dictionary_arrow=col._dictionary_arrow,
+    )

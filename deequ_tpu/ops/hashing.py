@@ -109,6 +109,13 @@ def xxhash64_strings(values: np.ndarray, seed: int = DEFAULT_SEED) -> np.ndarray
 
     if native_xxhash64_strings is not None:
         return native_xxhash64_strings(values, seed)
+    if not isinstance(values, np.ndarray):
+        # arrow input (e.g. a lazily-kept dictionary payload): materialize
+        # to python objects first — iterating the arrow array directly
+        # yields pa scalars whose nulls fail the `v is None` check and
+        # stringify to "None", hashing as that literal instead of the seed
+        vals = values.to_numpy(zero_copy_only=False)
+        values = vals if vals.dtype == object else vals.astype(object)
     out = np.empty(len(values), dtype=np.uint64)
     for idx, v in enumerate(values):
         if v is None:

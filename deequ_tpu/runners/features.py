@@ -147,10 +147,10 @@ def dict_type_codes(col) -> np.ndarray:
     code. Null/padding rows -> TYPE_NULL."""
     tc = col.aux.get("type_codes")
     if tc is None:
-        ones = np.ones(len(col.dictionary), dtype=bool)
-        tc = classify_type_codes(col.dictionary, ones, ColumnKind.STRING)
+        ones = np.ones(col.num_categories, dtype=bool)
+        tc = classify_type_codes(col.dictionary_source, ones, ColumnKind.STRING)
         col.aux["type_codes"] = tc
-    num_cats = len(col.dictionary)
+    num_cats = col.num_categories
     safe = np.where(col.codes < num_cats, col.codes, 0)
     out = tc[safe] if num_cats else np.zeros(len(col.codes), dtype=np.int32)
     out = np.where(col.mask, out, TYPE_NULL).astype(np.int32)
@@ -160,10 +160,10 @@ def dict_type_codes(col) -> np.ndarray:
 def dict_string_lengths(col) -> np.ndarray:
     ld = col.aux.get("lengths")
     if ld is None:
-        ones = np.ones(len(col.dictionary), dtype=bool)
-        ld = string_lengths(col.dictionary, ones)
+        ones = np.ones(col.num_categories, dtype=bool)
+        ld = string_lengths(col.dictionary_source, ones)
         col.aux["lengths"] = ld
-    num_cats = len(col.dictionary)
+    num_cats = col.num_categories
     safe = np.where(col.codes < num_cats, col.codes, 0)
     out = ld[safe] if num_cats else np.zeros(len(col.codes), dtype=np.int32)
     return np.where(col.mask, out, 0).astype(np.int32)
@@ -175,8 +175,8 @@ def dict_entry_hashes(col) -> np.ndarray:
     register pairs) derives from."""
     hd = col.aux.get("hashes")
     if hd is None:
-        ones = np.ones(len(col.dictionary), dtype=bool)
-        hd = hash_column(col.dictionary, ones, col.kind)
+        ones = np.ones(col.num_categories, dtype=bool)
+        hd = hash_column(col.dictionary_source, ones, col.kind)
         col.aux["hashes"] = hd
     return hd
 
@@ -185,7 +185,7 @@ def dict_hashes(col) -> np.ndarray:
     """Per-row xxhash64 via the cached distinct-value hashes + a gather.
     Masked rows carry arbitrary hashes — every consumer masks before use."""
     hd = dict_entry_hashes(col)
-    num_cats = len(col.dictionary)
+    num_cats = col.num_categories
     if not num_cats:
         return np.zeros(len(col.codes), dtype=np.uint64)
     safe = np.where(col.codes < num_cats, col.codes, 0)
@@ -194,7 +194,7 @@ def dict_hashes(col) -> np.ndarray:
 
 def _is_string_dict(col) -> bool:
     return (
-        col.dictionary is not None
+        col.has_dictionary
         and col.codes is not None
         and col.kind == ColumnKind.STRING
     )

@@ -352,8 +352,10 @@ class TestDictionaryFastPaths:
                 }, a
 
     def test_dictionary_decoded_once_per_dataset(self):
-        """The dictionary decodes and classifies once per dataset, not once
-        per batch: aux caches are shared across batches."""
+        """Dictionary artifacts compute once per dataset, not once per
+        batch (aux caches are shared across batches) — and a run whose
+        consumers read arrow buffers directly never pays the python-object
+        dictionary decode at all ("values" stays absent: lazy contract)."""
         from deequ_tpu.analyzers import DataType
 
         values = pa.array([f"v{i % 50}" for i in range(20_000)]).dictionary_encode()
@@ -362,4 +364,11 @@ class TestDictionaryFastPaths:
             data, [DataType("c")], placement="host", batch_size=1024
         )
         aux = data._dict_aux["c"]
-        assert "values" in aux and "type_codes" in aux
+        assert "type_codes" in aux
+        assert "values" not in aux, "type inference should not decode objects"
+        # the decode happens lazily — and lands in the shared cache — the
+        # moment a python-level consumer asks for the dictionary
+        for batch in data.batches(1024):
+            assert batch.column("c").dictionary is not None
+            break
+        assert "values" in aux

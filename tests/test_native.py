@@ -124,3 +124,24 @@ class TestRegexSemantics:
         np.testing.assert_array_equal(got_native, got_python)
         # 5\n, arabic digit, fullwidth digits -> STRING; "5" -> INTEGRAL; "1.5" -> FRACTIONAL
         assert list(got_python) == [4, 4, 4, 2, 1]
+
+
+class TestPythonFallbackArrowInputs:
+    def test_xxhash64_strings_fallback_handles_arrow_nulls(self, monkeypatch):
+        """The pure-python fallback must hash arrow-array inputs (the lazy
+        dictionary payload) identically to object arrays — in particular a
+        NULL entry hashes to the seed, not to the literal string 'None'."""
+        import numpy as np
+        import pyarrow as pa
+
+        import deequ_tpu.native as native
+        from deequ_tpu.ops import hashing
+
+        monkeypatch.setattr(native, "native_xxhash64_strings", None)
+        arr = pa.array(["a", None, "None", ""])
+        obj = np.array(["a", None, "None", ""], dtype=object)
+        got = hashing.xxhash64_strings(arr, 42)
+        want = hashing.xxhash64_strings(obj, 42)
+        np.testing.assert_array_equal(got, want)
+        assert got[1] == 42  # null -> seed
+        assert got[2] != 42  # a REAL "None" string must not collide
