@@ -361,6 +361,36 @@ class HostBatchContext:
             return self.batch.row_mask
         return self.pred_mask(where)
 
+    def row_mask_all(self) -> bool:
+        """Whether every row of the batch is valid (no padding) — gates the
+        shared dictionary fast paths; cached per batch."""
+        cached = self._pred_cache.get(("row_mask_all",))
+        if cached is None:
+            cached = bool(self.batch.row_mask.all())
+            self._pred_cache[("row_mask_all",)] = cached
+        return cached
+
+    def dict_code_counts(self, column: str) -> "Optional[np.ndarray]":
+        """int64[num_cats + 1] count per dictionary code over valid rows
+        (masked-out/null rows in the sentinel slot) — ONE native pass per
+        batch-column shared by the type-class histogram, the HLL
+        present-entry fold, and the device-frequency host partial. None when
+        the native kernel is unavailable (callers keep their numpy path)."""
+        from ..native import native_dict_masked_bincount
+
+        if native_dict_masked_bincount is None:
+            return None
+        key = ("dict_counts", column)
+        cached = self._pred_cache.get(key)
+        if cached is None:
+            col = self.batch.column(column)
+            mask = self.batch.row_mask & col.mask
+            cached = native_dict_masked_bincount(
+                col.codes, mask, col.num_categories
+            )
+            self._pred_cache[key] = cached
+        return cached
+
     def column_mask(self, analyzer, column: str) -> np.ndarray:
         return self.row_mask(analyzer) & self.batch.column(column).mask
 

@@ -532,10 +532,15 @@ class DeviceFrequencyScan(ScanShareableAnalyzer):
         from .states import FrequencyCountsState
 
         col = ctx.batch.column(self.column)
-        mask = ctx.batch.row_mask & col.mask
-        counts = np.bincount(
-            col.codes[mask], minlength=self.num_categories + 1
-        )[: self.num_categories]
+        shared = ctx.dict_code_counts(self.column)
+        if shared is not None:
+            # the shared one-pass native count (also feeds DataType/HLL)
+            counts = shared[: self.num_categories]
+        else:
+            mask = ctx.batch.row_mask & col.mask
+            counts = np.bincount(
+                col.codes[mask], minlength=self.num_categories + 1
+            )[: self.num_categories]
         return FrequencyCountsState(
             counts.astype(np.int64), np.asarray(ctx.batch.num_rows, dtype=np.int64)
         )

@@ -730,6 +730,27 @@ class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> DataTypeHistogram:
+        from ..runners.features import TYPE_NULL, _is_string_dict, dict_entry_type_codes
+
+        col = ctx.batch.column(self.column)
+        if (
+            _is_string_dict(col)
+            and self.where is None
+            and ctx.row_mask_all()
+            and ctx.dict_code_counts(self.column) is not None
+        ):
+            # dictionary fast path: aggregate the shared one-pass per-code
+            # counts through the cached per-DICT-ENTRY type codes — no
+            # per-row type-code gather or bincount at all. The sentinel slot
+            # (null values; no padding since row_mask is all-true) is
+            # TYPE_NULL by the reference's semantics.
+            by_code = ctx.dict_code_counts(self.column)
+            tc = dict_entry_type_codes(col)
+            counts = np.bincount(
+                tc, weights=by_code[: col.num_categories], minlength=5
+            )[:5].astype(np.int64)
+            counts[TYPE_NULL] += by_code[col.num_categories]
+            return DataTypeHistogram(counts.astype(COUNT_DTYPE))
         codes = ctx.type_codes(self.column)
         mask = ctx.row_mask(self)
         # all-true masks (no where-filter, unpadded host batches) skip the

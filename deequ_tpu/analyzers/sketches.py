@@ -102,7 +102,7 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
         if col.has_dictionary and col.codes is not None:
             # dictionary column: hash the DISTINCT values once (cached in
             # col.aux across batches), then max-scatter only the entries
-            # present in this batch — O(rows) bincount + O(dict) scatter
+            # present in this batch — O(rows) code counting + O(dict) scatter
             from ..ops.hll import M, hll_features
             from ..runners.features import dict_entry_hashes
 
@@ -112,12 +112,53 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
                 pairs = hll_features(dict_entry_hashes(col))
                 col.aux["hll_pairs"] = pairs
             num_cats = col.num_categories
-            counts = np.bincount(col.codes[mask], minlength=num_cats + 1)[:num_cats]
+            shared = (
+                ctx.dict_code_counts(self.column) if self.where is None else None
+            )
+            if shared is not None:
+                # the shared one-pass native count (sentinel slot = masked)
+                counts = shared[:num_cats]
+            else:
+                counts = np.bincount(
+                    col.codes[mask], minlength=num_cats + 1
+                )[:num_cats]
             present = counts > 0
-            regs = np.zeros(M, dtype=np.int32)
-            if num_cats:
-                np.maximum.at(regs, pairs[0][:num_cats][present], pairs[1][:num_cats][present])
-            return ApproxCountDistinctState(regs)
+            if not num_cats:
+                return ApproxCountDistinctState(np.zeros(M, dtype=np.int32))
+            aux = col.aux
+            regs_full = aux.get("hll_regs_full")
+            if regs_full is None:
+                # per-DATASET artifacts: registers over the whole
+                # dictionary, plus a register-sorted view of the (idx, pw)
+                # pairs so per-batch folds are a vectorized reduceat, not a
+                # serialized np.maximum.at ufunc loop (~2.5x at 200k
+                # categories)
+                idx, pw = pairs[0][:num_cats], pairs[1][:num_cats]
+                regs_full = np.zeros(M, dtype=np.int32)
+                np.maximum.at(regs_full, idx, pw)
+                perm = np.argsort(idx, kind="stable")
+                aux["hll_regs_full"] = regs_full
+                aux["hll_perm"] = perm
+                aux["hll_pw_sorted"] = pw[perm]
+                aux["hll_starts"] = np.searchsorted(idx[perm], np.arange(M))
+            if present.all():
+                # every dictionary entry occurs in this batch: the cached
+                # full-dictionary registers ARE the answer (states are
+                # treated as immutable downstream)
+                return ApproxCountDistinctState(regs_full)
+            perm = aux["hll_perm"]
+            pw_eff = np.where(present[perm], aux["hll_pw_sorted"], -1)
+            starts = aux["hll_starts"]
+            nexts = np.append(starts[1:], num_cats)
+            # a trailing -1 sentinel keeps every starts value (up to
+            # num_cats inclusive, for empty trailing registers) a valid
+            # reduceat index WITHOUT clamping — clamping to num_cats-1
+            # silently cut the last pair out of the topmost occupied
+            # register's segment whenever any register above it was empty
+            pw_ext = np.append(pw_eff, np.int32(-1))
+            seg = np.maximum.reduceat(pw_ext, starts)
+            seg = np.where(nexts > starts, seg, -1)
+            return ApproxCountDistinctState(np.maximum(seg, 0).astype(np.int32))
         if col.kind == ColumnKind.STRING:
             src = col.string_source
             if native_block_hll_strings is not None and (

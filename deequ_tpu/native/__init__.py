@@ -23,6 +23,7 @@ native_block_comoments = None
 native_block_hll = None
 native_block_hll_strings = None
 native_block_kll_sample = None
+native_dict_masked_bincount = None
 native_block_kll_pick = None
 
 try:  # pragma: no cover - exercised when the native lib is built
@@ -32,6 +33,7 @@ try:  # pragma: no cover - exercised when the native lib is built
         native_block_hll_strings,
         native_block_kll_pick,
         native_block_kll_sample,
+        native_dict_masked_bincount,
         native_block_stats,
         native_classify_types,
         native_hll_pack_numeric,

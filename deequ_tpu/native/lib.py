@@ -49,6 +49,9 @@ _lib.block_kll_sample_f64.argtypes = [
     _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
     _f64p, _i64p, _f64p,
 ]
+_lib.dict_masked_bincount.argtypes = [
+    _i32p, _u8p, ctypes.c_int64, ctypes.c_int64, _i64p,
+]
 _lib.block_kll_pick_f64.argtypes = [
     _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
     ctypes.c_int64, _f64p, _i64p,
@@ -264,3 +267,20 @@ def native_block_kll_sample(values: np.ndarray, mask, k: int, tick: int):
         # identity element: no items, min/max at the fold identities
         return items, 0, 0, 0, np.inf, -np.inf
     return items, m, h, nv, float(minmax[0]), float(minmax[1])
+
+
+def native_dict_masked_bincount(
+    codes: np.ndarray, mask, num_cats: int
+) -> np.ndarray:
+    """int64[num_cats + 1] counts of each dictionary code among masked rows;
+    masked-out or out-of-range rows land in the final sentinel slot. ONE
+    memory pass shared by every per-batch dictionary consumer (type-class
+    histogram, HLL present-entry fold, frequency counts)."""
+    codes = np.ascontiguousarray(codes, dtype=np.int32)
+    out = np.zeros(int(num_cats) + 1, dtype=np.int64)
+    _m, mp = _mask_u8(mask)
+    _lib.dict_masked_bincount(
+        _ptr(codes, _i32p), mp, len(codes), ctypes.c_int64(int(num_cats)),
+        _ptr(out, _i64p),
+    )
+    return out

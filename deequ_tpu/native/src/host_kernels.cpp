@@ -620,4 +620,22 @@ void block_kll_sample_f64(const double* v, const uint8_t* m, int64_t n,
   out_minmax[1] = mx;
 }
 
+// ---------------------------------------------------------------------------
+// dict_masked_bincount — one pass over a dictionary column's codes shared by
+// every per-batch consumer (type-class histogram, HLL present-entry fold,
+// frequency counts): out[c] += 1 for each masked row, rows with mask=0 or
+// code out of [0, num_cats) land in out[num_cats]. Replaces 3-4 numpy
+// passes (where + fancy-index copy + bincount) per consumer per column.
+// ---------------------------------------------------------------------------
+
+void dict_masked_bincount(const int32_t* codes, const uint8_t* mask,
+                          int64_t n, int64_t num_cats, int64_t* out) {
+  for (int64_t i = 0; i <= num_cats; ++i) out[i] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t c = codes[i];
+    int64_t slot = (mask[i] && c >= 0 && c < num_cats) ? c : num_cats;
+    ++out[slot];
+  }
+}
+
 }  // extern "C"

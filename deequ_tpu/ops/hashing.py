@@ -102,6 +102,17 @@ def xxhash64_bytes(data: bytes, seed: int = DEFAULT_SEED) -> int:
     return h
 
 
+def as_object_array(values) -> np.ndarray:
+    """Materialize a possibly-arrow string source into an object array —
+    the SINGLE null-preserving arrow→object conversion shared by every
+    pure-python string fallback (hashing here; classify/lengths in
+    runners.features import it)."""
+    if isinstance(values, np.ndarray):
+        return values
+    vals = values.to_numpy(zero_copy_only=False)
+    return vals if vals.dtype == object else vals.astype(object)
+
+
 def xxhash64_strings(values: np.ndarray, seed: int = DEFAULT_SEED) -> np.ndarray:
     """xxHash64 of a numpy object array of str/None. Nulls hash to the seed
     constant (they are masked out downstream anyway)."""
@@ -109,13 +120,11 @@ def xxhash64_strings(values: np.ndarray, seed: int = DEFAULT_SEED) -> np.ndarray
 
     if native_xxhash64_strings is not None:
         return native_xxhash64_strings(values, seed)
-    if not isinstance(values, np.ndarray):
-        # arrow input (e.g. a lazily-kept dictionary payload): materialize
-        # to python objects first — iterating the arrow array directly
-        # yields pa scalars whose nulls fail the `v is None` check and
-        # stringify to "None", hashing as that literal instead of the seed
-        vals = values.to_numpy(zero_copy_only=False)
-        values = vals if vals.dtype == object else vals.astype(object)
+    # arrow input (e.g. a lazily-kept dictionary payload): materialize to
+    # python objects first — iterating the arrow array directly yields pa
+    # scalars whose nulls fail the `v is None` check and stringify to
+    # "None", hashing as that literal instead of the seed
+    values = as_object_array(values)
     out = np.empty(len(values), dtype=np.uint64)
     for idx, v in enumerate(values):
         if v is None:

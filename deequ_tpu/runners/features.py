@@ -102,13 +102,7 @@ def classify_type_codes(values, mask: np.ndarray, kind: ColumnKind) -> np.ndarra
     return np.where(mask, np.int32(code), np.int32(TYPE_NULL)).astype(np.int32)
 
 
-def _as_object_array(values) -> np.ndarray:
-    """Materialize a possibly-arrow string source into an object array (the
-    python fallback paths need real python values)."""
-    if isinstance(values, np.ndarray):
-        return values
-    vals = values.to_numpy(zero_copy_only=False)
-    return vals if vals.dtype == object else vals.astype(object)
+from ..ops.hashing import as_object_array as _as_object_array  # noqa: E402
 
 
 def string_lengths(values, mask: np.ndarray) -> np.ndarray:
@@ -141,15 +135,21 @@ def regex_matches(values: np.ndarray, mask: np.ndarray, pattern: str) -> np.ndar
     return out
 
 
-def dict_type_codes(col) -> np.ndarray:
-    """Per-row type codes for a dictionary STRING column: classify the
-    DISTINCT values once (cached in col.aux across batches), gather by
-    code. Null/padding rows -> TYPE_NULL."""
+def dict_entry_type_codes(col) -> np.ndarray:
+    """Type codes of each DISTINCT dictionary value, classified once per
+    dataset (cached in col.aux across batches)."""
     tc = col.aux.get("type_codes")
     if tc is None:
         ones = np.ones(col.num_categories, dtype=bool)
         tc = classify_type_codes(col.dictionary_source, ones, ColumnKind.STRING)
         col.aux["type_codes"] = tc
+    return tc
+
+
+def dict_type_codes(col) -> np.ndarray:
+    """Per-row type codes for a dictionary STRING column: classify the
+    DISTINCT values once, gather by code. Null/padding rows -> TYPE_NULL."""
+    tc = dict_entry_type_codes(col)
     num_cats = col.num_categories
     safe = np.where(col.codes < num_cats, col.codes, 0)
     out = tc[safe] if num_cats else np.zeros(len(col.codes), dtype=np.int32)

@@ -159,3 +159,61 @@ class TestKLLParameterValidation:
         assert nv == 1000 and m <= 4
         items, m, h = native_block_kll_pick(v, None, 0, 0, 1000)
         assert m <= 4
+
+
+class TestDictHLLRegisterFold:
+    def _regs(self, values, take_rows):
+        """Registers from ApproxCountDistinct.host_partial over a batch
+        containing the first take_rows rows of a dictionary column."""
+        import pyarrow as pa
+
+        from deequ_tpu.analyzers import ApproxCountDistinct
+        from deequ_tpu.analyzers.base import HostBatchContext
+        from deequ_tpu.data import Dataset
+
+        arr = pa.array(values).dictionary_encode()
+        data = Dataset.from_arrow(pa.table({"c": arr}))
+        batch = next(iter(data.batches(take_rows)))
+        ctx = HostBatchContext(batch, batch_index=0)
+        return np.asarray(ApproxCountDistinct("c").host_partial(ctx).registers)
+
+    def _oracle(self, values, take_rows):
+        """The original scatter formulation over the same batch subset."""
+        import pyarrow as pa
+
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.ops.hll import M, hll_features
+        from deequ_tpu.runners.features import dict_entry_hashes
+
+        arr = pa.array(values).dictionary_encode()
+        data = Dataset.from_arrow(pa.table({"c": arr}))
+        batch = next(iter(data.batches(take_rows)))
+        col = batch.column("c")
+        pairs = hll_features(dict_entry_hashes(col))
+        mask = batch.row_mask & col.mask
+        counts = np.bincount(
+            col.codes[mask], minlength=col.num_categories + 1
+        )[: col.num_categories]
+        present = counts > 0
+        regs = np.zeros(M, dtype=np.int32)
+        if col.num_categories:
+            np.maximum.at(
+                regs,
+                pairs[0][: col.num_categories][present],
+                pairs[1][: col.num_categories][present],
+            )
+        return regs
+
+    def test_partial_batch_fold_matches_scatter_fuzz(self):
+        """Pin the reduceat fold (incl. the trailing-empty-register segment
+        bug: clamping the reduceat starts dropped the LAST sorted pair out
+        of the topmost occupied register whenever higher registers were
+        empty) against the scatter oracle across random partial batches."""
+        rng = np.random.default_rng(7)
+        for trial in range(20):
+            num_vals = int(rng.integers(50, 400))
+            values = [f"v{int(v)}" for v in rng.integers(0, 10_000, num_vals)]
+            take = int(rng.integers(1, num_vals + 1))
+            got = self._regs(values, take)
+            want = self._oracle(values, take)
+            np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
