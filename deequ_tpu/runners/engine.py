@@ -288,30 +288,43 @@ def _slim_kll_for_fetch(states: Tuple) -> Tuple[Tuple, List[Optional[int]]]:
     return tuple(slim), widths
 
 
+def _assert_kll_slim_invariant(sizes: np.ndarray, sketch_size: int) -> None:
+    """Losslessness of every slim-for-fetch variant rests on each non-top
+    level holding <= sketch_size items at fetch time (guaranteed because
+    every update/ingest/merge ends in a compaction cascade). A future code
+    path fetching mid-append would otherwise silently truncate items; the
+    shipped ``sizes`` let us fail loudly instead."""
+    if (sizes[:-1] > sketch_size).any():
+        raise AssertionError(
+            "KLL slim-for-fetch invariant violated: non-top level holds "
+            f"{int(sizes[:-1].max())} items > sketch_size "
+            f"{sketch_size}; state was fetched mid-append"
+        )
+
+
 def _restore_kll_width(fetched: List[Any], widths: List[Optional[int]]) -> List[Any]:
     for i, width in enumerate(widths):
         if width is None:
             continue
         low_state, top = fetched[i]
         low = np.asarray(low_state.items)
-        # Losslessness of the slim rests on every non-top level holding
-        # <= sketch_size items at fetch time (guaranteed because every
-        # update/ingest/merge ends in _compact_cascade). A future code path
-        # fetching mid-append would otherwise silently truncate items; the
-        # shipped `sizes` let us fail loudly instead.
-        sizes = np.asarray(low_state.sizes)
-        if (sizes[:-1] > low_state.sketch_size).any():
-            raise AssertionError(
-                "KLL slim-for-fetch invariant violated: non-top level holds "
-                f"{int(sizes[:-1].max())} items > sketch_size "
-                f"{low_state.sketch_size}; state was fetched mid-append"
-            )
+        _assert_kll_slim_invariant(np.asarray(low_state.sizes), low_state.sketch_size)
         pad = np.full((low.shape[0], width - low.shape[1]), np.inf, dtype=low.dtype)
         items = np.concatenate(
             [np.concatenate([low, pad], axis=1), np.asarray(top)], axis=0
         )
         fetched[i] = low_state.replace(items=items)
     return fetched
+
+
+#: floor on statically-slimmed KLL item bytes below which the two-phase
+#: fetch is never considered (the economic gate below also weighs the
+#: probed link bandwidth/latency)
+_TWO_PHASE_KLL_BYTES = 1 << 20
+
+#: fraction of the slimmed bytes the occupied-levels slice typically drops
+#: (~log2(rows/k) of 32 levels occupied)
+_TWO_PHASE_EXPECTED_SAVING = 0.6
 
 
 def _fetch_states_packed(states: Tuple) -> List[Any]:
@@ -323,11 +336,99 @@ def _fetch_states_packed(states: Tuple) -> List[Any]:
     the feed link; 64-bit leaves ride the f64 buffer as before. Both packs
     dispatch before either blocks, so the link sees back-to-back transfers.
     KLL item buffers additionally ship only their occupied column range
-    (see _slim_kll_for_fetch) and are re-padded host-side."""
+    (see _slim_kll_for_fetch) and are re-padded host-side; when the
+    battery carries enough sketch bytes, the two-phase variant also drops
+    every level row above the deepest occupied one."""
+    from ..ops.kll import KLLSketchState
+
+    kll_idx = [
+        i for i, s in enumerate(states)
+        if isinstance(s, KLLSketchState)
+        and s.items.ndim == 2
+        and s.items.shape[1] > s.sketch_size
+    ]
+    slim_bytes = sum(
+        ((states[i].items.shape[0] - 1) * states[i].sketch_size
+         + states[i].items.shape[1]) * states[i].items.dtype.itemsize
+        for i in kll_idx
+    )
+    if slim_bytes > _TWO_PHASE_KLL_BYTES:
+        # economic gate: splitting the fetch serializes one extra link
+        # round trip, so it must buy more transfer time than it costs —
+        # on a fast-but-latent link a few MB is cheaper in one shot
+        bw_bytes_per_s = probe_feed_bandwidth() * 1e6
+        expected_saving_s = _TWO_PHASE_EXPECTED_SAVING * slim_bytes / bw_bytes_per_s
+        if expected_saving_s > probe_feed_latency():
+            return _fetch_states_two_phase(states, kll_idx)
     states, kll_widths = _slim_kll_for_fetch(states)
     if any(w is not None for w in kll_widths):
         return _restore_kll_width(_fetch_states_packed_raw(states), kll_widths)
     return _fetch_states_packed_raw(states)
+
+
+def _fetch_states_two_phase(states: Tuple, kll_idx: List[int]) -> List[Any]:
+    """Two feed-link transfers instead of one, but only the OCCUPIED slice
+    of each KLL item buffer crosses the link: phase A ships every state
+    leaf except the item buffers (including the per-level ``sizes``), the
+    host derives each sketch's deepest occupied level, and phase B ships
+    rows ``[0..T]`` at sketch_size width (typical occupancy is ~log2(rows/k)
+    of the 32 levels, so this cuts the dominant fetch bytes another ~2-4x
+    on top of the width slim). The reconstruction re-pads with the +inf
+    structural padding; the non-top <= k occupancy invariant is asserted
+    exactly like the one-phase slim. Shipped row counts round up to the
+    next power of two so the packed-fetch program shapes stay stable
+    across runs with different occupancy depths (no recompile per
+    signature)."""
+    placeholders = {i: states[i].items for i in kll_idx}
+    stripped = list(states)
+    for i in kll_idx:
+        stripped[i] = states[i].replace(
+            items=jnp.zeros((0, 0), states[i].items.dtype)
+        )
+    fetched = _fetch_states_packed_raw(tuple(stripped))
+
+    slices: List[Any] = []
+    metas: List[Tuple[int, int, bool]] = []
+    for i in kll_idx:
+        st = fetched[i]
+        sizes = np.asarray(st.sizes)
+        _assert_kll_slim_invariant(sizes, st.sketch_size)
+        items = placeholders[i]
+        levels = items.shape[0]
+        k = st.sketch_size
+        occupied = np.nonzero(sizes > 0)[0]
+        top_level = int(occupied.max()) if occupied.size else -1
+        if top_level == levels - 1:
+            # the uncompacted top level can exceed k: ship it full width
+            slices.append((items[: levels - 1, :k], items[levels - 1 :, :]))
+            metas.append((i, 0, True))
+        else:
+            # power-of-two row count: stable packed-program shapes (at most
+            # log2(levels) variants) at <= 2x the minimal bytes; rows above
+            # the deepest occupied level are structural +inf padding
+            rows = 1
+            while rows < top_level + 1:
+                rows *= 2
+            rows = min(rows, levels - 1)
+            slices.append(items[:rows, :k])
+            metas.append((i, rows, False))
+    fetched_items = _fetch_states_packed_raw(tuple(slices))
+
+    for (i, rows, has_top), item in zip(metas, fetched_items):
+        st = fetched[i]
+        levels, width = placeholders[i].shape
+        k = st.sketch_size
+        full = np.full(
+            (levels, width), np.inf, dtype=np.dtype(placeholders[i].dtype.name)
+        )
+        if has_top:
+            low, top = item
+            full[: levels - 1, :k] = np.asarray(low)
+            full[levels - 1, :] = np.asarray(top)
+        elif rows:
+            full[:rows, :k] = np.asarray(item)
+        fetched[i] = st.replace(items=full)
+    return fetched
 
 
 def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
@@ -376,6 +477,7 @@ def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
 
 #: cached result of the device-feed bandwidth probe (MB/s), per process
 _FEED_BANDWIDTH_MBPS: Optional[float] = None
+_FEED_LATENCY_S: Optional[float] = None
 
 #: feed bandwidth below which raw column streaming to the device loses to
 #: host-side partial aggregation (a TPU-VM PCIe/DMA link runs at GB/s; a
@@ -391,7 +493,7 @@ def probe_feed_bandwidth() -> float:
     The first transfer of a process can pay one-time backend/tunnel
     initialization; an untimed warm-up plus best-of-3 keeps a transient
     stall from silently flipping every later auto-placement decision."""
-    global _FEED_BANDWIDTH_MBPS
+    global _FEED_BANDWIDTH_MBPS, _FEED_LATENCY_S
     if _FEED_BANDWIDTH_MBPS is None:
         # 1MB payload keeps probing a 6MB/s tunnel at ~1s, not ~5s; fixed
         # round-trip LATENCY is measured separately with a tiny transfer and
@@ -417,7 +519,14 @@ def probe_feed_bandwidth() -> float:
             transfer = max(elapsed - latency, 1e-9)
             best = max(best, 2 * arr.nbytes / transfer / 1e6)
         _FEED_BANDWIDTH_MBPS = best
+        _FEED_LATENCY_S = latency
     return _FEED_BANDWIDTH_MBPS
+
+
+def probe_feed_latency() -> float:
+    """Round-trip latency (seconds) of the feed link; probes on first use."""
+    probe_feed_bandwidth()
+    return _FEED_LATENCY_S if _FEED_LATENCY_S is not None else 0.0
 
 
 _INGEST_CACHE: Dict[Tuple, Any] = {}
