@@ -331,11 +331,18 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
 class HostBatchContext:
     """Per-batch helper for the host ingest tier: caches predicate masks so
     N analyzers sharing a `where` filter evaluate it once (the
-    `conditionalSelection` analog on the host side)."""
+    `conditionalSelection` analog on the host side).
 
-    def __init__(self, batch, batch_index: int = 0):
+    ``run_token`` identifies the enclosing PASS (one ScanEngine run): host
+    partials whose cross-batch skip caches live in the per-dataset
+    ``Column.aux`` dict key their entries on it, so a second pass over the
+    same dataset never reuses skip state from an earlier pass (which would
+    silently drop its contribution). ``None`` disables such caches."""
+
+    def __init__(self, batch, batch_index: int = 0, run_token=None):
         self.batch = batch
         self.batch_index = batch_index
+        self.run_token = run_token
         self._pred_cache: Dict[str, np.ndarray] = {}
         self._pred_columns = None
 

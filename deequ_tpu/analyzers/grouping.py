@@ -391,10 +391,30 @@ class FrequenciesAndNumRows:
                     return self
             vals = col.values
             if vals.dtype != object and np.issubdtype(vals.dtype, np.integer):
+                sel = vals[mask]
+                if sel.size:
+                    mn, mx = int(sel.min()), int(sel.max())
+                    if mx - mn < (1 << 16):
+                        # small-range integer keys (flags, line numbers,
+                        # ordinals): an offset bincount beats the sort
+                        # inside np.unique ~5x. Widen BEFORE subtracting:
+                        # int8/int16 columns spanning more than the dtype's
+                        # positive range would wrap (127 - (-128) -> -1)
+                        cnts = np.bincount(
+                            sel.astype(np.int64) - mn, minlength=mx - mn + 1
+                        )
+                        nz = np.flatnonzero(cnts)
+                        self._append_run(
+                            pd.Series(
+                                cnts[nz].astype(np.int64),
+                                index=(nz + mn).astype(sel.dtype),
+                            )
+                        )
+                        return self
                 # integer keys: np.unique sorts + counts ~6x faster than a
                 # pandas groupby (floats stay on the groupby path — NaN
                 # group-key identity is pandas' job)
-                uniques, cnts = np.unique(vals[mask], return_counts=True)
+                uniques, cnts = np.unique(sel, return_counts=True)
                 self._append_run(pd.Series(cnts.astype(np.int64), index=uniques))
                 return self
         frame = pd.DataFrame({n: c.values[mask] for n, c in columns.items()})
@@ -858,9 +878,51 @@ class Histogram(Analyzer["FrequenciesAndNumRows", HistogramMetric]):
     def host_init(self) -> FrequenciesAndNumRows:
         return FrequenciesAndNumRows.empty([self.column])
 
+    def _dict_keys(self, col) -> np.ndarray:
+        """Spark-string-cast of each DISTINCT dictionary entry, computed
+        once per dataset (cached in col.aux across batches)."""
+        keys = col.aux.get("hist_keys")
+        if keys is None:
+            keys = np.array(
+                [_spark_string_cast(v) for v in col.dictionary], dtype=object
+            )
+            col.aux["hist_keys"] = keys
+        return keys
+
     def host_update(self, state: FrequenciesAndNumRows, batch: Batch) -> FrequenciesAndNumRows:
         col = batch.column(self.column)
         mask = batch.row_mask
+        if self.binning_func is None and col.has_dictionary and col.codes is not None:
+            # dictionary column: one O(rows) code bincount; keys are the
+            # cached per-entry Spark string casts — no per-row values at all
+            from ..native import native_dict_masked_bincount
+
+            num_cats = col.num_categories
+            valid = mask & col.mask
+            if native_dict_masked_bincount is not None:
+                by_code = native_dict_masked_bincount(col.codes, valid, num_cats)[
+                    :num_cats
+                ]
+            else:
+                sel = col.codes[valid]
+                by_code = np.bincount(
+                    sel[(sel >= 0) & (sel < num_cats)], minlength=num_cats
+                )
+            n_null = int(np.count_nonzero(mask)) - int(by_code.sum())
+            nz = np.flatnonzero(by_code)
+            if len(nz):
+                keys = self._dict_keys(col)
+                counts = (
+                    pd.Series(by_code[nz].astype(np.int64), index=keys[nz])
+                    .groupby(level=0, sort=False)
+                    .sum()
+                )
+            else:
+                counts = pd.Series([], dtype=np.int64)
+            counts = _with_null_bin(counts, n_null)
+            state._append_run(counts.astype(np.int64))
+            state.num_rows += batch.num_rows
+            return state
         if (
             self.binning_func is None
             and col.arrow is not None

@@ -733,6 +733,23 @@ class DataType(ScanShareableAnalyzer[DataTypeHistogram, HistogramMetric]):
         from ..runners.features import TYPE_NULL, _is_string_dict, dict_entry_type_codes
 
         col = ctx.batch.column(self.column)
+        if _is_string_dict(col) and self.where is None and ctx.row_mask_all():
+            # uniform dictionary (every DISTINCT value classifies the same —
+            # the overwhelmingly common shape for real string columns): the
+            # histogram is just (valid count, null count), no per-code
+            # bincount at all
+            uniform = col.aux.get("tc_uniform")
+            if uniform is None:
+                tc = dict_entry_type_codes(col)
+                uniform = int(tc[0]) if len(tc) and (tc == tc[0]).all() else -1
+                col.aux["tc_uniform"] = uniform
+            if uniform > TYPE_NULL:
+                n = len(col.mask)
+                n_valid = int(np.count_nonzero(col.mask))
+                counts = np.zeros(5, dtype=np.int64)
+                counts[uniform] = n_valid
+                counts[TYPE_NULL] = n - n_valid
+                return DataTypeHistogram(counts.astype(COUNT_DTYPE))
         if (
             _is_string_dict(col)
             and self.where is None

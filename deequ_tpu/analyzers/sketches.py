@@ -112,17 +112,6 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
                 pairs = hll_features(dict_entry_hashes(col))
                 col.aux["hll_pairs"] = pairs
             num_cats = col.num_categories
-            shared = (
-                ctx.dict_code_counts(self.column) if self.where is None else None
-            )
-            if shared is not None:
-                # the shared one-pass native count (sentinel slot = masked)
-                counts = shared[:num_cats]
-            else:
-                counts = np.bincount(
-                    col.codes[mask], minlength=num_cats + 1
-                )[:num_cats]
-            present = counts > 0
             if not num_cats:
                 return ApproxCountDistinctState(np.zeros(M, dtype=np.int32))
             aux = col.aux
@@ -141,24 +130,123 @@ class ApproxCountDistinct(StandardScanShareableAnalyzer[ApproxCountDistinctState
                 aux["hll_perm"] = perm
                 aux["hll_pw_sorted"] = pw[perm]
                 aux["hll_starts"] = np.searchsorted(idx[perm], np.arange(M))
+            if self.where is None and ctx.run_token is not None:
+                # cross-batch skip: within one pass, registers are a MAX
+                # fold over batch partials, so an entry only needs to reach
+                # the fold through the FIRST batch that sees it — later
+                # batches contribute registers of NEW entries only, and once
+                # every dictionary entry has been seen the partial is the
+                # O(1) "saturated" zero state (a 1M-entry comment dictionary
+                # used to cost O(dict) per batch FOREVER; small dictionaries
+                # saturate after one batch). The token keys the seen-set to
+                # the enclosing pass. The lock only guards the epoch swap:
+                # concurrent workers marking entries can at worst duplicate
+                # a contribution (max-fold idempotent), never drop one — a
+                # batch only SKIPS an entry another batch of the same epoch
+                # already marked, and that batch contributed it.
+                import threading
+
+                lock = aux.setdefault("_hll_lock", threading.Lock())
+                with lock:
+                    if aux.get("hll_seen_full") is ctx.run_token:
+                        return ApproxCountDistinctState(np.zeros(M, dtype=np.int32))
+                    tok, seen = aux.get("hll_seen", (None, None))
+                    if tok is not ctx.run_token:
+                        seen = np.zeros(num_cats + 1, dtype=bool)
+                        seen[num_cats] = True
+                        aux["hll_seen"] = (ctx.run_token, seen)
+                idx, pw = pairs[0][:num_cats], pairs[1][:num_cats]
+                if num_cats > (1 << 16):
+                    # large dictionary: an O(rows) seen-lookup decides
+                    # cheaper than an O(rows + cats) presence bincount
+                    codes = np.where(col.codes < num_cats, col.codes, num_cats)
+                    unseen = ~seen[codes]
+                    n_unseen = int(np.count_nonzero(unseen))
+                    if n_unseen == 0:
+                        return ApproxCountDistinctState(
+                            np.zeros(M, dtype=np.int32)
+                        )
+                    if n_unseen <= len(codes) // 64:
+                        # near-saturation: tiny unique + sparse scatter-max
+                        new_codes = np.unique(codes[unseen])
+                        seen[new_codes] = True
+                        if seen.all():
+                            aux["hll_seen_full"] = ctx.run_token
+                        regs = np.zeros(M, dtype=np.int32)
+                        np.maximum.at(regs, idx[new_codes], pw[new_codes])
+                        return ApproxCountDistinctState(regs)
+                # warm-up shape: presence bincount, fold only NEW entries
+                counts = (
+                    ctx.dict_code_counts(self.column) if ctx.row_mask_all() else None
+                )
+                if counts is None:
+                    safe = np.where(col.codes < num_cats, col.codes, num_cats)
+                    counts = np.bincount(safe[mask], minlength=num_cats + 1)
+                present = counts[:num_cats] > 0
+                target = present & ~seen[:num_cats]
+                seen[:num_cats] |= present
+                if seen.all():
+                    aux["hll_seen_full"] = ctx.run_token
+                if not target.any():
+                    return ApproxCountDistinctState(np.zeros(M, dtype=np.int32))
+                if target.all():
+                    return ApproxCountDistinctState(regs_full.copy())
+                return ApproxCountDistinctState(
+                    self._regs_for_target(aux, pairs, target, num_cats)
+                )
+            shared = (
+                ctx.dict_code_counts(self.column) if self.where is None else None
+            )
+            if shared is not None:
+                # the shared one-pass native count (sentinel slot = masked)
+                counts = shared[:num_cats]
+            else:
+                counts = np.bincount(
+                    col.codes[mask], minlength=num_cats + 1
+                )[:num_cats]
+            present = counts > 0
             if present.all():
                 # every dictionary entry occurs in this batch: the cached
-                # full-dictionary registers ARE the answer (states are
-                # treated as immutable downstream)
-                return ApproxCountDistinctState(regs_full)
-            perm = aux["hll_perm"]
-            pw_eff = np.where(present[perm], aux["hll_pw_sorted"], -1)
-            starts = aux["hll_starts"]
-            nexts = np.append(starts[1:], num_cats)
-            # a trailing -1 sentinel keeps every starts value (up to
-            # num_cats inclusive, for empty trailing registers) a valid
-            # reduceat index WITHOUT clamping — clamping to num_cats-1
-            # silently cut the last pair out of the topmost occupied
-            # register's segment whenever any register above it was empty
-            pw_ext = np.append(pw_eff, np.int32(-1))
-            seg = np.maximum.reduceat(pw_ext, starts)
-            seg = np.where(nexts > starts, seg, -1)
-            return ApproxCountDistinctState(np.maximum(seg, 0).astype(np.int32))
+                # full-dictionary registers ARE the answer (copied — states
+                # must stay immutable downstream)
+                return ApproxCountDistinctState(regs_full.copy())
+            return ApproxCountDistinctState(
+                self._regs_for_target(aux, pairs, present, num_cats)
+            )
+        return self._host_partial_plain(col, mask)
+
+    def _regs_for_target(self, aux, pairs, target: np.ndarray, num_cats: int):
+        """Registers over the dictionary entries selected by ``target`` —
+        sparse scatter-max for few entries, register-sorted reduceat (the
+        cached per-dataset view) otherwise."""
+        from ..ops.hll import M
+
+        idx, pw = pairs[0][:num_cats], pairs[1][:num_cats]
+        n_target = int(np.count_nonzero(target))
+        if n_target * 8 < num_cats:
+            ti = np.flatnonzero(target)
+            regs = np.zeros(M, dtype=np.int32)
+            np.maximum.at(regs, idx[ti], pw[ti])
+            return regs
+        perm = aux["hll_perm"]
+        pw_eff = np.where(target[perm], aux["hll_pw_sorted"], -1)
+        starts = aux["hll_starts"]
+        nexts = np.append(starts[1:], num_cats)
+        # a trailing -1 sentinel keeps every starts value (up to
+        # num_cats inclusive, for empty trailing registers) a valid
+        # reduceat index WITHOUT clamping — clamping to num_cats-1
+        # silently cut the last pair out of the topmost occupied
+        # register's segment whenever any register above it was empty
+        pw_ext = np.append(pw_eff, np.int32(-1))
+        seg = np.maximum.reduceat(pw_ext, starts)
+        seg = np.where(nexts > starts, seg, -1)
+        return np.maximum(seg, 0).astype(np.int32)
+
+    def _host_partial_plain(self, col, mask) -> ApproxCountDistinctState:
+        from ..data import ColumnKind
+        from ..native import native_block_hll, native_block_hll_strings
+        from ..ops.hashing import DEFAULT_SEED
+
         if col.kind == ColumnKind.STRING:
             src = col.string_source
             if native_block_hll_strings is not None and (
@@ -346,11 +434,13 @@ def _np_kll_sample(values: np.ndarray, mask: np.ndarray, k: int, tick: int):
     stride >>= dense
     cap = k << dense
     # batch index XOR valid-count mixing, bit-identical to the native
-    # block_kll_sample_f64 (periodic streams must not phase-lock the stride)
-    r = (
-        (np.uint32(tick) * np.uint32(2654435761))
-        ^ (np.uint32(nv) * np.uint32(2246822519))
-    ) >> np.uint32(7)
+    # block_kll_sample_f64 (periodic streams must not phase-lock the stride;
+    # uint32 wraparound is the intended mixing, hence the errstate guard)
+    with np.errstate(over="ignore"):
+        r = (
+            (np.uint32(tick) * np.uint32(2654435761))
+            ^ (np.uint32(nv) * np.uint32(2246822519))
+        ) >> np.uint32(7)
     offset = int(r % np.uint32(stride))
     picked = np.sort(vv[offset::stride])[:cap]
     if dense == 2 and picked.size > 1:

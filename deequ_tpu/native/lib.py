@@ -56,6 +56,10 @@ _lib.block_kll_pick_f64.argtypes = [
     _f64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
     ctypes.c_int64, _f64p, _i64p,
 ]
+_lib.block_kll_pick_i64.argtypes = [
+    _i64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
+    ctypes.c_int64, _f64p, _i64p,
+]
 
 
 def _arrow_layout(values):
@@ -190,18 +194,27 @@ def native_block_stats(values: np.ndarray, mask) -> np.ndarray:
 def native_block_kll_pick(values: np.ndarray, mask, k: int, tick: int, nv: int):
     """(items f64[k] sorted asc with +inf padding, m, h) — the pick-only KLL
     sampler for callers that already know the non-NaN valid count ``nv``
-    from a shared block_stats pass (one less memory sweep)."""
+    from a shared block_stats pass (one less memory sweep). int64 columns
+    dispatch to the i64 kernel, which converts per PICKED item instead of
+    paying a full-column f64 conversion copy."""
     k = max(int(k), 1)  # keep the buffer in step with the kernel's k clamp
-    vals = np.ascontiguousarray(values, dtype=np.float64)
     # 4k wide: the kernel's stride policy picks up to two levels denser
     items = np.full(4 * k, np.inf, dtype=np.float64)
     meta = np.zeros(2, dtype=np.int64)
     _m, mp = _mask_u8(mask)
-    _lib.block_kll_pick_f64(
-        _ptr(vals, _f64p), mp, len(vals), ctypes.c_int32(k),
-        ctypes.c_uint32(tick & 0xFFFFFFFF), ctypes.c_int64(nv),
-        _ptr(items, _f64p), _ptr(meta, _i64p),
-    )
+    if values.dtype == np.int64 and values.flags.c_contiguous:
+        _lib.block_kll_pick_i64(
+            _ptr(values, _i64p), mp, len(values), ctypes.c_int32(k),
+            ctypes.c_uint32(tick & 0xFFFFFFFF), ctypes.c_int64(nv),
+            _ptr(items, _f64p), _ptr(meta, _i64p),
+        )
+    else:
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        _lib.block_kll_pick_f64(
+            _ptr(vals, _f64p), mp, len(vals), ctypes.c_int32(k),
+            ctypes.c_uint32(tick & 0xFFFFFFFF), ctypes.c_int64(nv),
+            _ptr(items, _f64p), _ptr(meta, _i64p),
+        )
     m = int(meta[0])
     items[m:] = np.inf
     return items, m, int(meta[1])

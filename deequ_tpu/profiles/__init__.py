@@ -195,6 +195,24 @@ class ColumnProfiler:
             ) in (INTEGRAL, FRACTIONAL):
                 # skipped when the user predefines the column as non-numeric
                 first_pass += _numeric_analyzers(name, kll_parameters)
+        # histograms of DICTIONARY-ENCODED columns whose dictionary is
+        # already <= the cardinality threshold join pass 1 (distinct <=
+        # dictionary size, so eligibility cannot be decided otherwise after
+        # the scan); the reference always needs its third pass for these
+        # (`ColumnProfiler.scala:181-205`). Columns the HLL estimate later
+        # DISQUALIFIES (estimate error can exceed the threshold even when
+        # the true cardinality is under it) are dropped below, preserving
+        # reference semantics. Histograms count ORIGINAL values, so running
+        # them before the numeric-string cast is exactly right.
+        hist_pass1 = {
+            name
+            for name in relevant
+            if (
+                (size := data.dictionary_size(name)) is not None
+                and size <= low_cardinality_histogram_threshold
+            )
+        }
+        first_pass += [Histogram(name) for name in sorted(hist_pass1)]
         first_results = AnalysisRunner.do_analysis_run(data, first_pass, **run_kwargs)
 
         generic = _extract_generic_statistics(
@@ -223,9 +241,11 @@ class ColumnProfiler:
         )
         # histograms must count ORIGINAL values (reference pass 3 reads the
         # raw data, `getHistogramsForThirdPass`): share pass 2 only for
-        # columns the cast did not touch, else run them in an extra pass
-        shared_hist = [c for c in histogram_columns if c not in casted_names]
-        extra_hist = [c for c in histogram_columns if c in casted_names]
+        # columns the cast did not touch, else run them in an extra pass;
+        # columns already histogrammed in pass 1 are done either way
+        remaining_hist = [c for c in histogram_columns if c not in hist_pass1]
+        shared_hist = [c for c in remaining_hist if c not in casted_names]
+        extra_hist = [c for c in remaining_hist if c in casted_names]
         second_pass += [Histogram(name) for name in shared_hist]
         second_results = (
             AnalysisRunner.do_analysis_run(casted, second_pass, **run_kwargs)
@@ -242,11 +262,16 @@ class ColumnProfiler:
 
         numeric_stats = _extract_numeric_statistics(first_results, second_results)
         histograms: Dict[str, Distribution] = {}
-        for results in (second_results, third_results):
+        eligible_hist = set(histogram_columns)
+        for results in (first_results, second_results, third_results):
             if results is None:
                 continue
             for analyzer, metric in results.metric_map.items():
-                if isinstance(analyzer, Histogram) and metric.value.is_success:
+                if (
+                    isinstance(analyzer, Histogram)
+                    and metric.value.is_success
+                    and analyzer.column in eligible_hist
+                ):
                     histograms[analyzer.column] = metric.value.get()
 
         return _create_profiles(relevant, generic, numeric_stats, histograms)

@@ -798,9 +798,14 @@ class ScanEngine:
             chunk = _INGEST_CHUNK
             program = _ingest_program(analyzers)
 
+        # one token per pass: host partials may skip work a previous batch
+        # of the SAME pass already contributed (e.g. HLL registers of
+        # dictionary entries already seen) but never across passes
+        run_token = object()
+
         def compute_partial(index: int, batch) -> Tuple:
             with monitor.timed("host_partials"):
-                ctx = HostBatchContext(batch, batch_index=index)
+                ctx = HostBatchContext(batch, batch_index=index, run_token=run_token)
                 return tuple(a.host_partial(ctx) for a in analyzers)
 
         def fold_chunk(states, group: List[Tuple], n_real: int):

@@ -227,12 +227,38 @@ class TestProfilerPassCounts:
         assert profile.mean == pytest.approx(float(a.mean()), rel=1e-9)
         assert profile.kll is not None
 
-    def test_low_cardinality_strings_add_histogram_pass(self):
+    def test_low_cardinality_strings_profile_in_one_pass(self):
         import numpy as np
 
         from deequ_tpu.profiles import ColumnProfilerRunner
         from deequ_tpu.runners.engine import RunMonitor
 
+        rng = np.random.default_rng(1)
+        data = Dataset.from_dict(
+            {
+                "n": rng.normal(size=2000),
+                "c": [f"c{int(v)}" for v in rng.integers(0, 5, 2000)],
+            }
+        )
+        # ingest-time adaptive dictionary encoding makes the low-card string
+        # column's histogram eligible for pass 1 (distinct <= dictionary
+        # size <= threshold), so the whole profile is ONE data pass — the
+        # reference needs three (`ColumnProfiler.scala:57-68`)
+        mon = RunMonitor()
+        result = ColumnProfilerRunner.on_data(data).with_monitor(mon).run()
+        assert mon.passes == 1, mon.passes
+        assert result.profiles["c"].histogram is not None
+        hist = result.profiles["c"].histogram
+        assert sum(v.absolute for v in hist.values.values()) == 2000
+
+    def test_unencoded_low_cardinality_strings_add_histogram_pass(self, monkeypatch):
+        import numpy as np
+
+        from deequ_tpu.data import ADAPTIVE_DICT_ENCODE_ENV
+        from deequ_tpu.profiles import ColumnProfilerRunner
+        from deequ_tpu.runners.engine import RunMonitor
+
+        monkeypatch.setenv(ADAPTIVE_DICT_ENCODE_ENV, "0")
         rng = np.random.default_rng(1)
         data = Dataset.from_dict(
             {
