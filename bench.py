@@ -434,6 +434,103 @@ def run_device_resident_stage(
     }
 
 
+def run_device_profile_stage(target_rows: int | None = None) -> dict:
+    """DEVICE-PLACEMENT full column profile at config-3 (lineitem) shape:
+    the REAL ColumnProfilerRunner over REAL data with `placement="device"`
+    and the engine's device feature cache enabled, so the timed (second)
+    run reads every feature batch from HBM — no tunnel feed in the timed
+    path. Unlike the synthetic [device-scan] stage this produces real
+    metrics, which are parity-checked below; timing is plain wall clock of
+    the whole run, whose own state fetches force device completion (the
+    block_until_ready trap does not apply to full host fetches).
+
+    Row count adapts to the probed feed bandwidth so the one-time staging
+    run fits DEEQU_TPU_BENCH_STAGE_BUDGET_S (default 180s)."""
+    import os
+
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.profiles import ColumnProfilerRunner
+    from deequ_tpu.runners.engine import (
+        RunMonitor,
+        clear_device_feature_cache,
+        probe_feed_bandwidth,
+    )
+
+    bytes_per_row = 150.0  # pass-1 features at lineitem shape
+    if target_rows is None:
+        budget_s = float(os.environ.get("DEEQU_TPU_BENCH_STAGE_BUDGET_S", "180"))
+        bw = probe_feed_bandwidth()
+        target_rows = int(bw * 1e6 * budget_s / bytes_per_row)
+    rows = max(2 << 20, min(target_rows, 32 << 20))
+    rows = (rows >> 20) << 20  # whole 1M-row batches
+    log(f"[device-profile] building {rows:,}-row lineitem table (16 cols)")
+    table = build_lineitem_data(rows)
+    data = Dataset.from_arrow(table)
+
+    prior = os.environ.get("DEEQU_TPU_DEVICE_FEATURE_CACHE")
+    os.environ["DEEQU_TPU_DEVICE_FEATURE_CACHE"] = "8"
+    try:
+        t0 = time.perf_counter()
+        runner = (
+            ColumnProfilerRunner.on_data(data)
+            .with_placement("device")
+            .with_batch_size(1 << 20)
+        )
+        profiles = runner.run()  # stages features into HBM + compiles
+        stage_s = time.perf_counter() - t0
+
+        mon = RunMonitor()
+        t0 = time.perf_counter()
+        profiles = (
+            ColumnProfilerRunner.on_data(data)
+            .with_placement("device")
+            .with_batch_size(1 << 20)
+            .with_monitor(mon)
+            .run()
+        )
+        elapsed = time.perf_counter() - t0
+    finally:
+        clear_device_feature_cache()
+        if prior is None:
+            os.environ.pop("DEEQU_TPU_DEVICE_FEATURE_CACHE", None)
+        else:
+            os.environ["DEEQU_TPU_DEVICE_FEATURE_CACHE"] = prior
+
+    # parity: real metrics from the device run vs full-data numpy oracles
+    for name in ("l_quantity", "l_extendedprice", "l_discount", "l_tax"):
+        arr = table[name].to_numpy()
+        p = profiles.profiles[name]
+        for got, want in (
+            (p.mean, arr.mean()), (p.minimum, arr.min()), (p.maximum, arr.max()),
+            (p.std_dev, arr.std()), (p.sum, arr.sum()),
+        ):
+            if abs(got - want) > 1e-6 * max(1.0, abs(want)):
+                log(f"PARITY MISMATCH {name}: got={got} want={want}")
+                sys.exit(1)
+    flags = profiles.profiles["l_returnflag"].histogram
+    import pyarrow.compute as pc
+
+    vc = pc.value_counts(table["l_returnflag"])
+    want_counts = {
+        str(v["values"]): int(v["counts"]) for v in vc.to_pylist()
+    }
+    got_counts = {k: v.absolute for k, v in flags.values.items()}
+    if got_counts != want_counts:
+        log(f"PARITY MISMATCH l_returnflag histogram: {got_counts} != {want_counts}")
+        sys.exit(1)
+
+    rate = rows / elapsed
+    phases = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(mon.phase_seconds.items()))
+    log(
+        f"[device-profile] {rows:,} rows x 16 cols, placement=device, warm "
+        f"feature cache: {elapsed:.2f}s -> {rate/1e6:.1f}M rows/s/chip "
+        f"(passes={mon.passes}; staging+compile run took {stage_s:.1f}s; "
+        f"metrics parity-checked vs numpy/arrow oracles)"
+    )
+    log(f"[device-profile] phases: {phases}")
+    return {"rows_per_sec": rate, "rows": rows, "stage_seconds": stage_s}
+
+
 def run_device_merge_stage(
     n_states: int = 64, n_hll_states: int = 2048, target_seconds: float = 3.0
 ) -> dict:
@@ -737,6 +834,7 @@ def main() -> None:
     log(f"feed-link probe: {probe_feed_bandwidth():.0f} MB/s")
 
     device = run_device_resident_stage()
+    device_profile = run_device_profile_stage()
     merge = run_device_merge_stage()
 
     # The bench host is SHARED: under heavy contention the host-tier stages
@@ -787,6 +885,8 @@ def main() -> None:
                 "vs_64core_linear": round(profile["vs_64core_linear"], 3),
                 "device_scan_rows_per_sec": round(device["rows_per_sec"], 1),
                 "device_scan_gbps": round(device["achieved_gbps"], 2),
+                "device_profile_rows_per_sec": round(device_profile["rows_per_sec"], 1),
+                "device_profile_rows": device_profile["rows"],
                 "sketch_merge_gbps": round(merge["kll"], 3),
                 "hll_merge_gbps": round(merge["hll"], 3),
                 "scan_rows_per_sec_per_chip": round(scan["rows_per_sec"], 1),

@@ -393,22 +393,28 @@ class FrequenciesAndNumRows:
             if vals.dtype != object and np.issubdtype(vals.dtype, np.integer):
                 sel = vals[mask]
                 if sel.size:
-                    mn, mx = int(sel.min()), int(sel.max())
-                    if mx - mn < (1 << 16):
+                    smn, smx = sel.min(), sel.max()
+                    if int(smx) - int(smn) < (1 << 16):
                         # small-range integer keys (flags, line numbers,
                         # ordinals): an offset bincount beats the sort
-                        # inside np.unique ~5x. Widen BEFORE subtracting:
-                        # int8/int16 columns spanning more than the dtype's
-                        # positive range would wrap (127 - (-128) -> -1)
-                        cnts = np.bincount(
-                            sel.astype(np.int64) - mn, minlength=mx - mn + 1
-                        )
+                        # inside np.unique ~5x. Dtype care: signed narrow
+                        # dtypes wrap on in-dtype subtraction (int8:
+                        # 127-(-128) -> -1) so they widen first; uint64
+                        # values above 2^63 overflow int64 so unsigned
+                        # subtracts in-dtype (exact — range < 2^16) and
+                        # rebuilds keys in-dtype too.
+                        if np.issubdtype(sel.dtype, np.signedinteger):
+                            offs = sel.astype(np.int64) - int(smn)
+                        else:
+                            offs = (sel - smn).astype(np.int64)
+                        cnts = np.bincount(offs, minlength=int(smx) - int(smn) + 1)
                         nz = np.flatnonzero(cnts)
+                        if np.issubdtype(sel.dtype, np.signedinteger):
+                            keys = (nz + int(smn)).astype(sel.dtype)
+                        else:
+                            keys = nz.astype(sel.dtype) + smn
                         self._append_run(
-                            pd.Series(
-                                cnts[nz].astype(np.int64),
-                                index=(nz + mn).astype(sel.dtype),
-                            )
+                            pd.Series(cnts[nz].astype(np.int64), index=keys)
                         )
                         return self
                 # integer keys: np.unique sorts + counts ~6x faster than a
@@ -829,6 +835,24 @@ def _spark_string_cast(value) -> str:
     if isinstance(value, (int, np.integer)):
         return str(int(value))
     return str(value)
+
+
+def device_counts_to_histogram_frequencies(
+    scan: "DeviceFrequencyScan", state, dictionary: np.ndarray
+) -> FrequenciesAndNumRows:
+    """Device frequency counts -> the Histogram state shape: keys become
+    their Spark string casts and null rows land in the NullValue bin, so
+    the resulting FrequenciesAndNumRows is indistinguishable from the host
+    accumulator's (merge/persist/metric all behave identically)."""
+    counts = np.asarray(state.counts)
+    nz = np.flatnonzero(counts)
+    keys = [_spark_string_cast(v) for v in np.asarray(dictionary)[nz]]
+    series = pd.Series(counts[nz].astype(np.int64), index=keys)
+    if series.index.has_duplicates:
+        series = series.groupby(level=0, sort=False).sum()
+    num_rows = int(state.num_rows)
+    series = _with_null_bin(series, num_rows - int(counts.sum()))
+    return FrequenciesAndNumRows(series.astype(np.int64), num_rows, [scan.column])
 
 
 NULL_FIELD_REPLACEMENT = "NullValue"  # reference `analyzers/Histogram.scala:108`

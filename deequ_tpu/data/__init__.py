@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Mapping, Optional, Sequence, Union
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
@@ -293,6 +293,11 @@ class Dataset:
         #: decoded dictionaries + derived-artifact caches, one per column,
         #: shared by every batch this dataset yields
         self._dict_aux: Dict[str, dict] = {}
+        #: memoized derived VIEWS of this dataset (e.g. the profiler's
+        #: casted/encoded pass-2 table), so repeated runs reuse one arrow
+        #: table identity — which also keeps the engine's device feature
+        #: cache hot across runs
+        self.derived_cache: Dict[Any, "Dataset"] = {}
 
     # -- constructors -------------------------------------------------------
 
@@ -368,6 +373,29 @@ class Dataset:
         if col.num_chunks == 0:
             return np.array([], dtype=object)
         return _decode_dictionary(col.chunk(0).dictionary, self._schema[name].kind)
+
+    def with_columns_dictionary_encoded(self, names: Sequence[str]) -> "Dataset":
+        """Dictionary-encode the given (plain) columns — works for any
+        primitive type, e.g. a float column known to be low-cardinality.
+        Columns that fail to encode are left untouched."""
+        import pyarrow.compute as pc
+
+        table = self._table
+        for name in names:
+            if name not in self._schema:
+                continue
+            if pa.types.is_dictionary(table.schema.field(name).type):
+                continue
+            try:
+                encoded = pc.dictionary_encode(table[name])
+            except Exception:  # noqa: BLE001
+                continue
+            table = table.set_column(
+                table.schema.get_field_index(name), name, encoded
+            )
+        if table is self._table:
+            return self
+        return Dataset(table)
 
     def with_column_cast_to_f64(self, name: str) -> "Dataset":
         """Replace a string column by its parsed-float64 version (profiler
@@ -501,15 +529,26 @@ def _maybe_dictionary_encode(table: "pa.Table") -> "pa.Table":
         ):
             continue
         column = table.column(i)
-        probe = column.slice(0, _ENCODE_PROBE_ROWS)
+        # probe the head, middle AND tail: a column clustered/sorted by the
+        # key (low-card head, high-card tail) must be rejected here, before
+        # the full-column encode — the post-encode guard below still
+        # catches what three slices miss, but the probes keep the common
+        # clustered case from paying a full encode on EVERY construction
         try:
-            distinct = pc.count_distinct(probe).as_py()
+            qualified = True
+            for start in (0, max((n - _ENCODE_PROBE_ROWS) // 2, 0),
+                          max(n - _ENCODE_PROBE_ROWS, 0)):
+                probe = column.slice(start, _ENCODE_PROBE_ROWS)
+                distinct = pc.count_distinct(probe).as_py()
+                # smaller tables qualify with proportionally smaller
+                # dictionaries — 1000 rows with 900 distinct gains nothing
+                limit = min(_ENCODE_MAX_PROBE_DISTINCT, max(len(probe) // 8, 1))
+                if distinct > limit:
+                    qualified = False
+                    break
         except Exception:  # noqa: BLE001 - exotic layout: leave column alone
             continue
-        # smaller tables qualify with proportionally smaller dictionaries —
-        # a 1000-row table with 900 distinct values gains nothing
-        limit = min(_ENCODE_MAX_PROBE_DISTINCT, max(len(probe) // 8, 1))
-        if distinct > limit:
+        if not qualified:
             continue
         try:
             encoded = pc.dictionary_encode(column)

@@ -153,6 +153,7 @@ class ColumnProfiler:
         batch_size: Optional[int] = None,
         monitor=None,
         sharding=None,
+        placement: Optional[str] = None,
     ) -> ColumnProfiles:
         """(reference `ColumnProfiler.profile`, `ColumnProfiler.scala:91-208`)."""
         predefined_types = dict(predefined_types or {})
@@ -174,6 +175,7 @@ class ColumnProfiler:
             batch_size=batch_size,
             monitor=monitor,
             sharding=sharding,
+            placement=placement,
         )
 
         # ---- PASS 1: generic statistics (reference `:122-139`) PLUS the
@@ -246,6 +248,21 @@ class ColumnProfiler:
         remaining_hist = [c for c in histogram_columns if c not in hist_pass1]
         shared_hist = [c for c in remaining_hist if c not in casted_names]
         extra_hist = [c for c in remaining_hist if c in casted_names]
+        # pass-1 estimates prove these columns low-cardinality, so encode
+        # them now (floats/ints included): their histograms then ride the
+        # device frequency scan instead of a per-batch host group-by. The
+        # encoded view memoizes on the source dataset so repeated profiles
+        # reuse ONE arrow table (keeping the device feature cache hot).
+        encodable = tuple(
+            c for c in shared_hist if casted.dictionary_size(c) is None
+        )
+        if encodable:
+            ekey = ("__profile_encoded__", tuple(sorted(casted_names)), encodable)
+            encoded = data.derived_cache.get(ekey)
+            if encoded is None:
+                encoded = casted.with_columns_dictionary_encoded(encodable)
+                data.derived_cache[ekey] = encoded
+            casted = encoded
         second_pass += [Histogram(name) for name in shared_hist]
         second_results = (
             AnalysisRunner.do_analysis_run(casted, second_pass, **run_kwargs)
@@ -337,15 +354,24 @@ def _extract_generic_statistics(
 
 def _cast_numeric_string_columns(columns, data: Dataset, generic):
     """(reference `castColumn`/`castNumericStringColumns`,
-    `ColumnProfiler.scala:346-354,294-308`). Returns (dataset, casted names)."""
-    casted = data
-    names = set()
-    for name in columns:
-        if data.schema[name].kind != ColumnKind.STRING:
-            continue
-        if generic.type_of(name) in (INTEGRAL, FRACTIONAL):
+    `ColumnProfiler.scala:346-354,294-308`). Returns (dataset, casted names).
+    The casted view memoizes on the source dataset (same inferred types ->
+    same view), so repeated profiles share one arrow table identity."""
+    names = {
+        name
+        for name in columns
+        if data.schema[name].kind == ColumnKind.STRING
+        and generic.type_of(name) in (INTEGRAL, FRACTIONAL)
+    }
+    if not names:
+        return data, names
+    key = ("__profile_casted__", tuple(sorted(names)))
+    casted = data.derived_cache.get(key)
+    if casted is None:
+        casted = data
+        for name in sorted(names):
             casted = casted.with_column_cast_to_f64(name)
-            names.add(name)
+        data.derived_cache[key] = casted
     return casted, names
 
 
@@ -483,6 +509,7 @@ class ColumnProfilerRunBuilder:
         self._batch_size: Optional[int] = None
         self._monitor = None
         self._sharding = None
+        self._placement: Optional[str] = None
 
     def restrict_to_columns(self, columns: Sequence[str]):
         self._columns = columns
@@ -533,6 +560,12 @@ class ColumnProfilerRunBuilder:
         self._sharding = sharding
         return self
 
+    def with_placement(self, placement: str):
+        """Force the ingest tier ("device" / "host"; default auto-probes
+        the feed link)."""
+        self._placement = placement
+        return self
+
     def run(self) -> ColumnProfiles:
         profiles = ColumnProfiler.profile(
             self.data,
@@ -548,6 +581,7 @@ class ColumnProfilerRunBuilder:
             batch_size=self._batch_size,
             monitor=self._monitor,
             sharding=self._sharding,
+            placement=self._placement,
         )
         if self._profiles_path is not None:
             from .. import io as dio

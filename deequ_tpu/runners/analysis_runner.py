@@ -117,13 +117,41 @@ class AnalysisRunner:
         precondition_failures = AnalyzerContext(failures)
 
         # split: device-fused scan / grouping sets / host accumulators
+        from ..analyzers.grouping import (
+            DEVICE_FREQ_MAX_CARDINALITY,
+            DeviceFrequencyScan,
+        )
+
         scanning = [a for a in passed if isinstance(a, ScanShareableAnalyzer)]
         grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
-        host_accum = [a for a in passed if hasattr(a, "host_init") and not isinstance(a, GroupingAnalyzer)]
+        # binning-free Histograms over small-dictionary columns share the
+        # device frequency scan instead of accumulating a host group-by per
+        # batch (their metric is derived from the same counts; keys are
+        # Spark-string-cast at finalize). The reference always runs its own
+        # groupBy per Histogram (`analyzers/Histogram.scala:54-96`).
+        device_hist = [
+            a
+            for a in passed
+            if isinstance(a, Histogram)
+            and a.binning_func is None
+            and (size := data.dictionary_size(a.column)) is not None
+            and size <= DEVICE_FREQ_MAX_CARDINALITY
+        ]
+        device_hist_set = set(device_hist)
+        host_accum = [
+            a
+            for a in passed
+            if hasattr(a, "host_init")
+            and not isinstance(a, GroupingAnalyzer)
+            and a not in device_hist_set
+        ]
         others = [
             a
             for a in passed
-            if a not in scanning and a not in grouping and a not in host_accum
+            if a not in scanning
+            and a not in grouping
+            and a not in host_accum
+            and a not in device_hist_set
         ]
 
         grouping_sets: Dict[Tuple[str, ...], List[GroupingAnalyzer]] = {}
@@ -134,19 +162,23 @@ class AnalysisRunner:
         # dictionary is small ride the fused DEVICE scan as a segment_sum
         # (SURVEY §7 step 6's low-cardinality hybrid); everything else
         # accumulates through the amortized host group-by
-        from ..analyzers.grouping import (
-            DEVICE_FREQ_MAX_CARDINALITY,
-            DeviceFrequencyScan,
-        )
-
         device_freq: Dict[Tuple[str, ...], DeviceFrequencyScan] = {}
         device_dicts: Dict[Tuple[str, ...], Any] = {}
-        for cols in grouping_sets:
+        for cols in list(grouping_sets) + [(a.column,) for a in device_hist]:
+            if cols in device_freq:
+                continue
             if len(cols) == 1:
                 dictionary = data.dictionary_values(cols[0])
                 if dictionary is not None and len(dictionary) <= DEVICE_FREQ_MAX_CARDINALITY:
                     device_freq[cols] = DeviceFrequencyScan(cols[0], len(dictionary))
                     device_dicts[cols] = dictionary
+        # a histogram column whose dictionary out-sizes the device path
+        # falls back to the host accumulator
+        for a in device_hist:
+            if (a.column,) not in device_freq:
+                device_hist_set.discard(a)
+                host_accum.append(a)
+        device_hist = [a for a in device_hist if a in device_hist_set]
 
         # one shared pass over the data
         scan_battery = scanning + list(device_freq.values())
@@ -179,7 +211,7 @@ class AnalysisRunner:
             except Exception as exc:  # noqa: BLE001
                 # pass-level failure: every analyzer in the shared scan gets a
                 # failure metric (reference `AnalysisRunner.scala:320-323`)
-                for a in scanning + grouping + host_accum:
+                for a in scanning + grouping + host_accum + device_hist:
                     metrics[a] = a.to_failure_metric(exc)
             else:
                 # scanning analyzers: load old state -> merge -> persist -> metric
@@ -201,6 +233,16 @@ class AnalysisRunner:
                         metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
                 for a in host_accum:
                     metrics[a] = _finalize(a, host_states[a], aggregate_with, save_states_with)
+                from ..analyzers.grouping import device_counts_to_histogram_frequencies
+
+                for a in device_hist:
+                    cols = (a.column,)
+                    shared = device_counts_to_histogram_frequencies(
+                        device_freq[cols],
+                        device_freq_states[cols],
+                        device_dicts[cols],
+                    )
+                    metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
         for a in others:
             metrics[a] = a.to_failure_metric(
                 MetricCalculationException(f"No execution strategy for analyzer {a}")

@@ -529,6 +529,51 @@ def probe_feed_latency() -> float:
     return _FEED_LATENCY_S if _FEED_LATENCY_S is not None else 0.0
 
 
+class _DeviceFeatureCache:
+    """Device-RESIDENT feature cache (opt-in): per-(table, batching,
+    battery) feature arrays stay in HBM across passes and runs, so a warm
+    run over the same dataset streams nothing over the feed link — the
+    device-placement analog of a cached columnar scan. Strong table refs
+    pin the id()-based keys; the byte budget simply stops admitting new
+    entries once exhausted (no eviction — the cache exists for bounded
+    bench/warm-run working sets, not arbitrary workloads)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.bytes = 0
+        self.store: Dict[Tuple, Dict[str, Any]] = {}
+        self.tables: Dict[int, Any] = {}
+
+    def clear(self) -> None:
+        self.store.clear()
+        self.tables.clear()
+        self.bytes = 0
+
+
+#: env var enabling the device feature cache; value = HBM budget in GB
+DEVICE_FEATURE_CACHE_ENV = "DEEQU_TPU_DEVICE_FEATURE_CACHE"
+_DEVICE_FEATURE_CACHE: Optional[_DeviceFeatureCache] = None
+
+
+def device_feature_cache() -> Optional[_DeviceFeatureCache]:
+    import os
+
+    global _DEVICE_FEATURE_CACHE
+    env = os.environ.get(DEVICE_FEATURE_CACHE_ENV)
+    if not env or env == "0":
+        return None
+    if _DEVICE_FEATURE_CACHE is None:
+        _DEVICE_FEATURE_CACHE = _DeviceFeatureCache(int(float(env) * 1e9))
+    return _DEVICE_FEATURE_CACHE
+
+
+def clear_device_feature_cache() -> None:
+    global _DEVICE_FEATURE_CACHE
+    if _DEVICE_FEATURE_CACHE is not None:
+        _DEVICE_FEATURE_CACHE.clear()
+    _DEVICE_FEATURE_CACHE = None
+
+
 _INGEST_CACHE: Dict[Tuple, Any] = {}
 
 #: batches folded per ingest-program call; fixed so the program shape (and
@@ -722,13 +767,40 @@ class ScanEngine:
         # batch i — the analog of Spark overlapping scan IO with aggregation
         batches = data.batches(bs, columns=columns)
 
+        cache = device_feature_cache() if self._update is not None else None
+        if cache is not None:
+            cache_base = (
+                id(data.arrow),
+                bs,
+                None if columns is None else tuple(columns),
+                tuple(sorted(self.builder.specs)),
+            )
+        import itertools
+
+        idx_counter = itertools.count()
+
         def produce():
+            index = next(idx_counter)
             try:
                 batch = next(batches)
             except StopIteration:
                 return None
-            features = self._prepare(batch) if self._update is not None else None
-            return batch, features
+            if self._update is None:
+                return batch, None
+            if cache is not None:
+                key = cache_base + (index,)
+                features = cache.store.get(key)
+                if features is None:
+                    features = self._prepare(batch)
+                    nbytes = sum(v.nbytes for v in features.values())
+                    if cache.bytes + nbytes <= cache.budget:
+                        cache.store[key] = features
+                        cache.bytes += nbytes
+                        # pin the table only once something of it is cached
+                        # (the id()-keyed entries must not outlive the table)
+                        cache.tables[id(data.arrow)] = data.arrow
+                return batch, features
+            return batch, self._prepare(batch)
 
         carry = self._update.init_carry() if self._update is not None else None
         with ThreadPoolExecutor(max_workers=1) as pool:

@@ -198,6 +198,21 @@ class TestHistogramFastPaths:
             len(np.unique(vals))
         )
 
+    def test_uint64_above_int63_bincount(self):
+        # uint64 values past 2^63: widening to int64 would overflow, so the
+        # unsigned path subtracts in-dtype (exact — the range is tiny)
+        base = np.uint64(2**63)
+        vals = np.array([base + 1, base + 5, base + 1, base + 3], dtype=np.uint64)
+        data = Dataset.from_dict({"u": vals})
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [CountDistinct(["u"]), Histogram("u")], batch_size=4
+        )
+        assert ctx.metric(CountDistinct(["u"])).value.get() == 3.0
+        dist = ctx.metric(Histogram("u")).value.get()
+        assert {k: v.absolute for k, v in dist.values.items()} == {
+            str(int(base) + 1): 2, str(int(base) + 3): 1, str(int(base) + 5): 1
+        }
+
     def test_narrow_int_dtype_full_range_bincount(self):
         # int8 spanning [-128, 127]: the offset subtraction must widen
         # first, or it wraps and np.bincount rejects the negatives
