@@ -247,10 +247,12 @@ class Analyzer(abc.ABC, Generic[S, M]):
 
 
 #: jit'd per-analyzer state-fold programs, keyed by (analyzer, shard count);
-#: bounded FIFO so a long-lived service cycling through many analyzer
-#: identities / partition counts cannot grow it without limit
-_MERGE_FOLD_CACHE: Dict[Any, Any] = {}
-_MERGE_FOLD_CACHE_MAX = 256
+#: bounded LRU so a long-lived service cycling through many analyzer
+#: identities / partition counts cannot grow it without limit, while hot
+#: keys stay resident
+from ..utils import BoundedLRU
+
+_MERGE_FOLD_CACHE = BoundedLRU(256)
 
 
 def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optional[Any]:
@@ -322,8 +324,6 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
             return out
 
         program = jax.jit(fold)
-        if len(_MERGE_FOLD_CACHE) >= _MERGE_FOLD_CACHE_MAX:
-            _MERGE_FOLD_CACHE.pop(next(iter(_MERGE_FOLD_CACHE)))
         _MERGE_FOLD_CACHE[key] = program
     return jax.device_get(program(stacked))
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -29,7 +28,65 @@ from .grouping import FrequenciesAndNumRows
 #: order/shapes or the sidecar schema; the loader refuses newer versions
 #: instead of misreading them (SURVEY §7 hard part 5). v1 is frozen by
 #: tests/test_state_serde.py::TestFormatVersioning::test_v1_npz_layout_pinned.
-STATE_FORMAT_VERSION = 1
+#: v2 replaced the v1 treedef PICKLE sidecar with a static state-type
+#: registry recorded inside the .npz (name + static fields) — loading
+#: never unpickles, so a blob from a shared object store cannot execute
+#: code (the reference's fixed per-type binary codecs carry the same
+#: property, `StateProvider.scala:187-241`). v1 .npz blobs still load:
+#: their leaf order is identical and their structure derives from the
+#: requesting analyzer, ignoring the legacy .pkl sidecar entirely.
+STATE_FORMAT_VERSION = 2
+
+
+def _state_registry() -> Dict[str, type]:
+    """Persistable state types by name — the reconstruction allowlist."""
+    from ..ops.kll import KLLSketchState
+    from . import states as s
+
+    classes = [
+        s.FrequencyCountsState, s.NumMatches, s.NumMatchesAndCount,
+        s.MeanState, s.SumState, s.MinState, s.MaxState,
+        s.StandardDeviationState, s.CorrelationState, s.DataTypeHistogram,
+        s.ApproxCountDistinctState, KLLSketchState,
+    ]
+    return {cls.__name__: cls for cls in classes}
+
+
+def _split_fields(cls) -> "tuple[list, list]":
+    """(data field names in flatten order, static field names) of a
+    flax.struct dataclass — the flatten order IS declaration order."""
+    import dataclasses
+
+    data, static = [], []
+    for f in dataclasses.fields(cls):
+        (data if f.metadata.get("pytree_node", True) else static).append(f.name)
+    return data, static
+
+
+def _reconstruct_state(type_name: str, static: Dict[str, Any], leaves: list) -> Any:
+    registry = _state_registry()
+    cls = registry.get(type_name)
+    if cls is None:
+        raise ValueError(
+            f"persisted state type {type_name!r} is not in the reconstruction "
+            f"registry ({sorted(registry)}); refusing to load"
+        )
+    data_fields, static_fields = _split_fields(cls)
+    if len(leaves) != len(data_fields):
+        raise ValueError(
+            f"persisted {type_name} blob carries {len(leaves)} leaves, "
+            f"expected {len(data_fields)} ({data_fields}); blob is corrupt "
+            "or from an incompatible version"
+        )
+    if set(static) != set(static_fields):
+        # exact match required: a MISSING static field would silently fall
+        # back to the class default (e.g. a KLL blob reconstructing with the
+        # wrong sketch_size against its own leaf shapes)
+        raise ValueError(
+            f"persisted {type_name} blob static fields {sorted(static)} do "
+            f"not match the type's {sorted(static_fields)}"
+        )
+    return cls(**dict(zip(data_fields, leaves)), **static)
 
 
 def _check_state_version(found: int, kind: str) -> None:
@@ -121,18 +178,28 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                     fh,
                 )
             return
-        # numpy/jax pytree: flatten to arrays + structure pickle
+        # numpy/jax pytree: leaves as .npz arrays + the state-type name and
+        # static fields as plain JSON INSIDE the npz — no pickle anywhere
         import jax
 
-        leaves, treedef = jax.tree_util.tree_flatten(state)
+        leaves, _ = jax.tree_util.tree_flatten(state)
+        type_name = type(state).__name__
+        if type_name not in _state_registry():
+            raise ValueError(
+                f"state type {type_name!r} is not registered for persistence; "
+                "add it to _state_registry so it can be reconstructed "
+                "without code execution on load"
+            )
+        _, static_fields = _split_fields(type(state))
+        static = {name: getattr(state, name) for name in static_fields}
         with dio.open_file(base + "-state.npz", "wb") as fh:
             np.savez(
                 fh,
                 __format_version__=np.int64(STATE_FORMAT_VERSION),
+                __state_type__=np.str_(type_name),
+                __static__=np.str_(json.dumps(static)),
                 **{f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)},
             )
-        with dio.open_file(base + "-treedef.pkl", "wb") as fh:
-            pickle.dump((type(state).__name__, treedef), fh)
 
     def load(self, analyzer: Analyzer) -> Optional[Any]:
         from .. import io as dio
@@ -161,13 +228,31 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
             import jax
 
-            with dio.open_file(base + "-treedef.pkl", "rb") as fh:
-                _, treedef = pickle.load(fh)
             with dio.open_file(base + "-state.npz", "rb") as fh:
                 data = np.load(_io.BytesIO(fh.read()))
             if "__format_version__" in data.files:
                 _check_state_version(int(data["__format_version__"]), ".npz state blob")
             n_leaves = sum(1 for f in data.files if f.startswith("leaf"))
             leaves = [data[f"leaf{i}"] for i in range(n_leaves)]
+            if "__state_type__" in data.files:
+                # v2: reconstruct via the static registry
+                return _reconstruct_state(
+                    str(data["__state_type__"]),
+                    json.loads(str(data["__static__"])),
+                    leaves,
+                )
+            # v1 blob: same leaf order, but the structure rode a pickle
+            # sidecar. Never unpickle it — the requesting analyzer's own
+            # state structure (class + static fields) is authoritative and
+            # reproduces the treedef exactly.
+            shapes = jax.eval_shape(analyzer.init_state)
+            treedef = jax.tree_util.tree_structure(shapes)
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"v1 state blob for {analyzer} carries {len(leaves)} "
+                    f"leaves but the analyzer's state has "
+                    f"{treedef.num_leaves}; blob is corrupt or from an "
+                    "incompatible analyzer"
+                )
             return jax.tree_util.tree_unflatten(treedef, leaves)
         return None

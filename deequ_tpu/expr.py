@@ -25,7 +25,7 @@ from __future__ import annotations
 import ast
 import functools as _functools
 import re
-from typing import Callable, Dict, Union
+from typing import Callable, Dict, List, Tuple, Union
 
 import numpy as np
 
@@ -119,6 +119,10 @@ _FUNCTIONS: Dict[str, Callable] = {
     "floor": np.floor,
     "ceil": np.ceil,
     "sqrt": np.sqrt,
+    # SQL COALESCE(col, default): nulls (None / NaN) replaced by the
+    # default — the form the reference's isNonNegative/isPositive emit
+    # (`checks/Check.scala:734,751`)
+    "coalesce": lambda x, v: np.where(_null_mask(x), v, np.asarray(x)),
 }
 
 def _neq(a, b) -> np.ndarray:
@@ -255,6 +259,11 @@ class _Evaluator(ast.NodeVisitor):
         left = _materialize(left)
         right = _materialize(right)
         if isinstance(op, (ast.In, ast.NotIn)):
+            if isinstance(right, (str, int, float)) and not isinstance(right, bool):
+                # `x in ('abc')`: Python collapses 1-element parens to a
+                # scalar, but in the SQL dialect this is a 1-element IN
+                # list (there is no substring-membership in this grammar)
+                right = [right]
             if not isinstance(right, (list, tuple, set)):
                 raise ExpressionError("`in` requires a literal list/tuple")
             left_arr = np.asarray(left)
@@ -318,10 +327,14 @@ class _Evaluator(ast.NodeVisitor):
             )
 
     def visit_Call(self, node):
-        if not isinstance(node.func, ast.Name) or node.func.id not in _FUNCTIONS:
+        # case-insensitive lookup: SQL spellings (COALESCE, LENGTH) parse
+        # as ordinary Python calls and must resolve too
+        fn = None
+        if isinstance(node.func, ast.Name):
+            fn = _FUNCTIONS.get(node.func.id) or _FUNCTIONS.get(node.func.id.lower())
+        if fn is None:
             raise ExpressionError("only whitelisted functions allowed")
         args = [self.visit(a) for a in node.args]
-        fn = _FUNCTIONS[node.func.id]
         if (
             args
             and isinstance(args[0], DictColumn)
@@ -340,11 +353,149 @@ class _Evaluator(ast.NodeVisitor):
         return [self.visit(e) for e in node.elts]
 
 
+#: SQL keywords the translator maps to the Python grammar (case-insensitive)
+_SQL_WORD_MAP = {"and": "and", "or": "or", "not": "not", "null": "None",
+                 "true": "True", "false": "False"}
+
+
+def _translate_sql_predicate(src: str) -> str:
+    """Translate the Spark-SQL predicate subset the reference emits into
+    the Python-syntax grammar: `=`/`<>` comparisons, AND/OR/NOT, IN
+    lists, IS (NOT) NULL, backquoted identifiers, ''-escaped string
+    literals, and SQL function names (reference `checks/Check.scala:
+    786-799,734,751,913,942`; `examples/BasicExample.scala`). Keywords
+    match case-insensitively, as Spark's parser does."""
+    tokens: List[Tuple[str, str]] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c.isspace():
+            i += 1
+        elif c in ("'", '"'):
+            # Spark accepts single- OR double-quoted string literals, with
+            # a doubled quote char as the escape
+            q = c
+            j, buf = i + 1, []
+            while j < n:
+                if src[j] == q:
+                    if j + 1 < n and src[j + 1] == q:
+                        buf.append(q)
+                        j += 2
+                        continue
+                    break
+                buf.append(src[j])
+                j += 1
+            if j >= n:
+                raise ExpressionError(f"unterminated string literal in {src!r}")
+            tokens.append(("str", "".join(buf)))
+            i = j + 1
+        elif c == "`":
+            j = src.find("`", i + 1)
+            if j < 0:
+                raise ExpressionError(f"unterminated `identifier` in {src!r}")
+            name = src[i + 1 : j]
+            if not name.isidentifier():
+                raise ExpressionError(
+                    f"column name {name!r} is not expressible in predicates "
+                    "(rename the column to a valid identifier)"
+                )
+            tokens.append(("name", name))
+            i = j + 1
+        elif c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            tokens.append(("word", src[i:j]))
+            i = j
+        elif c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit()):
+            j = i
+            while j < n and (src[j].isdigit() or src[j] in ".eE" or (
+                src[j] in "+-" and src[j - 1] in "eE"
+            )):
+                j += 1
+            tokens.append(("num", src[i:j]))
+            i = j
+        elif src[i : i + 2] in ("<=", ">=", "!=", "=="):
+            tokens.append(("op", src[i : i + 2]))
+            i += 2
+        elif src[i : i + 2] == "<>":
+            tokens.append(("op", "!="))
+            i += 2
+        elif c == "=":
+            tokens.append(("op", "=="))
+            i += 1
+        else:
+            tokens.append(("op", c))
+            i += 1
+
+    out: List[str] = []
+    k = 0
+    while k < len(tokens):
+        kind, text = tokens[k]
+        low = text.lower() if kind == "word" else None
+        if kind == "str":
+            out.append(repr(text))
+        elif kind == "name":
+            out.append(text)
+        elif kind == "word" and low == "is":
+            # IS [NOT] NULL
+            if k + 2 < len(tokens) and tokens[k + 1][1].lower() == "not" and tokens[k + 2][1].lower() == "null":
+                out.append("is not None")
+                k += 2
+            elif k + 1 < len(tokens) and tokens[k + 1][1].lower() == "null":
+                out.append("is None")
+                k += 1
+            else:
+                raise ExpressionError(f"IS must be followed by [NOT] NULL in {src!r}")
+        elif kind == "word" and low == "in":
+            # IN ( a, b, ... ) -> in [a, b, ...] (a 1-element SQL list must
+            # not become a Python scalar paren-expression)
+            if k + 1 >= len(tokens) or tokens[k + 1][1] != "(":
+                raise ExpressionError(f"IN must be followed by a value list in {src!r}")
+            out.append("in [")
+            depth = 1
+            k += 1  # consume the opening paren
+            closed = False
+            while k + 1 < len(tokens):
+                k += 1
+                tk, tt = tokens[k]
+                if tk == "op" and tt == "(":
+                    depth += 1
+                elif tk == "op" and tt == ")":
+                    depth -= 1
+                    if depth == 0:
+                        out.append("]")
+                        closed = True
+                        break
+                out.append(repr(tt) if tk == "str" else tt)
+            if not closed:
+                raise ExpressionError(f"unbalanced IN list in {src!r}")
+        elif kind == "word" and low in _SQL_WORD_MAP:
+            out.append(_SQL_WORD_MAP[low])
+        elif kind == "word" and low in _FUNCTIONS:
+            out.append(low)
+        else:
+            out.append(text)
+        k += 1
+    return " ".join(out)
+
+
 @_functools.lru_cache(maxsize=512)
 def _parse_predicate(src: str) -> ast.AST:
     """Predicates re-evaluate once per batch per pass; ast.parse is pure,
-    so the parses cache (thread-safe via lru_cache)."""
-    return ast.parse(src, mode="eval")
+    so the parses cache (thread-safe via lru_cache). Strings that are not
+    valid Python expressions get one shot through the Spark-SQL
+    translator, so reference check definitions run verbatim."""
+    try:
+        return ast.parse(src, mode="eval")
+    except SyntaxError as py_exc:
+        try:
+            return ast.parse(_translate_sql_predicate(src), mode="eval")
+        except (SyntaxError, ExpressionError) as sql_exc:
+            raise ExpressionError(
+                f"predicate {src!r} is neither a valid Python expression "
+                f"({py_exc}) nor translatable SQL ({sql_exc})"
+            ) from None
 
 
 def evaluate_predicate(predicate: Predicate, columns: Dict[str, np.ndarray], n: int) -> np.ndarray:

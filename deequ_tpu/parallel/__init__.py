@@ -95,8 +95,9 @@ _SHARDED_INGEST_CACHE: dict = {}
 #: jitted collective-merge programs keyed by (analyzers, devices, local
 #: shard count, padded leaf shapes/dtypes); bounded FIFO like the engine's
 #: merge-fold cache
-_COLLECTIVE_MERGE_CACHE: dict = {}
-_COLLECTIVE_MERGE_CACHE_MAX = 64
+from ..utils import BoundedLRU
+
+_COLLECTIVE_MERGE_CACHE = BoundedLRU(64)
 
 
 def sharded_ingest_fold(
@@ -270,8 +271,6 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
                 check_vma=False,
             )
         )
-        if len(_COLLECTIVE_MERGE_CACHE) >= _COLLECTIVE_MERGE_CACHE_MAX:
-            _COLLECTIVE_MERGE_CACHE.pop(next(iter(_COLLECTIVE_MERGE_CACHE)))
         _COLLECTIVE_MERGE_CACHE[cache_key] = program
     merged = program(padded)
     # every device holds the identical full merge; take device 0's copy

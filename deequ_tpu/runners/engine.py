@@ -618,8 +618,9 @@ _INGEST_CHUNK = 32
 #: datasets, so cold runs converge on warm)
 _INGEST_BUNDLE = 8
 
-_INGEST_SIG_CACHE: Dict[Any, Tuple] = {}
-_INGEST_SIG_CACHE_MAX = 4096
+from ..utils import BoundedLRU
+
+_INGEST_SIG_CACHE = BoundedLRU(4096)
 
 
 def _ingest_signature(a: ScanShareableAnalyzer) -> Tuple:
@@ -639,8 +640,6 @@ def _ingest_signature(a: ScanShareableAnalyzer) -> Tuple:
             str(treedef),
             tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
         )
-        if len(_INGEST_SIG_CACHE) >= _INGEST_SIG_CACHE_MAX:
-            _INGEST_SIG_CACHE.pop(next(iter(_INGEST_SIG_CACHE)))
         _INGEST_SIG_CACHE[a] = sig
     return sig
 

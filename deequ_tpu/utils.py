@@ -1,0 +1,54 @@
+"""Small shared utilities."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any
+
+
+class BoundedLRU:
+    """A bounded mapping with least-recently-USED eviction for compiled
+    program caches: a hot key touched on every run stays resident while
+    cold keys age out. (The previous bounded caches evicted FIFO, so a
+    long-lived service could evict its hottest program while one-shot keys
+    lingered.) A lock guards the compound lookup-then-reorder/evict steps
+    so concurrent readers/writers (the engine's partial pool) keep plain
+    dict.get semantics — get never raises."""
+
+    def __init__(self, max_size: int):
+        import threading
+
+        self.max_size = int(max_size)
+        self._lock = threading.Lock()
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                value = self._data[key]
+            except KeyError:
+                return default
+            self._data.move_to_end(key)
+            return value
+
+    def __setitem__(self, key, value) -> None:
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            elif len(self._data) >= self.max_size:
+                self._data.popitem(last=False)
+            self._data[key] = value
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def keys(self):
+        return list(self._data.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()

@@ -398,3 +398,92 @@ class TestJavaDoubleToStringParity:
         assert dist["1.0E7"].absolute == 2
         assert dist["0.5"].absolute == 1
         assert dist["1.0E-4"].absolute == 1
+
+
+class TestTwoPhaseFetchParity:
+    """ADVICE r4: _fetch_states_two_phase's economic gate never fires in CI,
+    so pin it DIRECTLY (bypassing the gate) against the one-phase slim path
+    across occupancy shapes, including an empty sketch and an occupied top
+    level."""
+
+    def _sketch(self, values):
+        import jax.numpy as jnp
+
+        from deequ_tpu.ops.kll import kll_init, kll_update
+
+        state = kll_init(64)
+        if len(values):
+            v = jnp.asarray(np.asarray(values, dtype=np.float64))
+            state = kll_update(state, v, jnp.ones(len(values), dtype=bool))
+        return state
+
+    def _occupied_top(self):
+        import jax.numpy as jnp
+
+        from deequ_tpu.ops.kll import kll_init
+
+        state = kll_init(64)
+        items = np.asarray(state.items).copy()
+        sizes = np.asarray(state.sizes).copy()
+        items[-1, :70] = np.sort(np.linspace(0, 1, 70))
+        sizes[-1] = 70
+        return state.replace(
+            items=jnp.asarray(items), sizes=jnp.asarray(sizes),
+            count=jnp.asarray(70 << 31, dtype=state.count.dtype),
+        )
+
+    def test_matches_one_phase_slim_path(self):
+        import jax
+
+        from deequ_tpu.ops.kll import KLLSketchState
+        from deequ_tpu.runners.engine import (
+            _fetch_states_packed_raw,
+            _fetch_states_two_phase,
+            _restore_kll_width,
+            _slim_kll_for_fetch,
+        )
+
+        rng = np.random.default_rng(12)
+        states = (
+            self._sketch(rng.normal(size=50_000)),  # multi-level occupancy
+            self._sketch([]),                       # empty sketch
+            self._sketch(rng.normal(size=100)),     # single level
+            self._occupied_top(),                   # top level occupied
+        )
+        states = tuple(jax.device_put(s) for s in states)
+        kll_idx = [
+            i for i, s in enumerate(states)
+            if isinstance(s, KLLSketchState) and s.items.shape[1] > s.sketch_size
+        ]
+        two_phase = _fetch_states_two_phase(states, kll_idx)
+        slim, widths = _slim_kll_for_fetch(states)
+        one_phase = _restore_kll_width(_fetch_states_packed_raw(slim), widths)
+        for a, b in zip(two_phase, one_phase):
+            la, ta = jax.tree_util.tree_flatten(a)
+            lb, tb = jax.tree_util.tree_flatten(b)
+            assert ta == tb
+            for x, y in zip(la, lb):
+                assert np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+
+
+class TestDictMaskedBincountFuzz:
+    """ADVICE r4: fuzz native_dict_masked_bincount against the masked
+    np.bincount formulation, covering out-of-range and negative codes."""
+
+    def test_fuzz_against_numpy_oracle(self):
+        from deequ_tpu.native import native_dict_masked_bincount
+
+        if native_dict_masked_bincount is None:
+            pytest.skip("native kernels unavailable")
+        rng = np.random.default_rng(13)
+        for trial in range(25):
+            n = int(rng.integers(0, 5000))
+            num_cats = int(rng.integers(1, 50))
+            codes = rng.integers(-3, num_cats + 4, n).astype(np.int32)
+            mask = rng.random(n) < rng.random()
+            got = native_dict_masked_bincount(codes, mask, num_cats)
+            want = np.zeros(num_cats + 1, dtype=np.int64)
+            in_range = mask & (codes >= 0) & (codes < num_cats)
+            np.add.at(want, codes[in_range], 1)
+            want[num_cats] = n - int(in_range.sum())
+            assert np.array_equal(got, want), trial

@@ -389,15 +389,94 @@ class TestFormatVersioning:
         assert serialize_results([result]) == frozen
         assert _json.loads(frozen)  # stays valid JSON
 
-    def test_v1_npz_layout_pinned(self, tmp_path):
-        """Freeze the v1 .npz state layout for MeanState: leaf order is
-        (total, count). If this breaks, bump STATE_FORMAT_VERSION."""
+    def test_v2_npz_layout_pinned(self, tmp_path):
+        """Freeze the v2 .npz state layout for MeanState: leaf order is
+        (total, count) plus the registry markers. If this breaks, bump
+        STATE_FORMAT_VERSION."""
         from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
 
         data = Dataset.from_dict({"x": np.arange(10, dtype=np.float64)})
         sp = FileSystemStateProvider(str(tmp_path))
         AnalysisRunner.do_analysis_run(data, [Mean("x")], save_states_with=sp)
         payload = np.load(next(iter(tmp_path.glob("*-state.npz"))))
-        assert sorted(payload.files) == ["__format_version__", "leaf0", "leaf1"]
+        assert sorted(payload.files) == [
+            "__format_version__", "__state_type__", "__static__", "leaf0", "leaf1",
+        ]
+        assert int(payload["__format_version__"]) == 2
+        assert str(payload["__state_type__"]) == "MeanState"
         assert float(payload["leaf0"]) == 45.0   # sum
         assert int(payload["leaf1"]) == 10       # count
+        # and no pickle sidecar exists anymore
+        assert not list(tmp_path.glob("*-treedef.pkl"))
+
+    def test_v1_blob_loads_without_unpickling(self, tmp_path):
+        """A round-<=4 v1 blob (positional leaves + a pickle treedef
+        sidecar) must load through the analyzer-derived structure with the
+        pickle file left UNREAD — a poisoned sidecar cannot execute."""
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Mean("x")
+        base = str(tmp_path / sp._key(a))
+        np.savez(
+            base + "-state.npz",
+            __format_version__=np.int64(1),
+            leaf0=np.float64(45.0),
+            leaf1=np.int64(10),
+        )
+        with open(base + "-treedef.pkl", "wb") as fh:
+            fh.write(b"\x80\x04poisoned pickle that must never be loaded")
+        state = sp.load(a)
+        assert float(state.total) == 45.0 and int(state.count) == 10
+
+    def test_kll_static_field_round_trip(self, tmp_path):
+        """KLLSketchState's static sketch_size survives the v2 registry
+        round-trip for non-default parameters."""
+        from deequ_tpu.analyzers import KLLParameters, KLLSketch
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        data = Dataset.from_dict({"x": np.arange(5000, dtype=np.float64)})
+        a = KLLSketch("x", KLLParameters(sketch_size=512))
+        sp = FileSystemStateProvider(str(tmp_path))
+        AnalysisRunner.do_analysis_run(data, [a], save_states_with=sp)
+        state = sp.load(a)
+        assert state.sketch_size == 512
+        assert int(state.count) == 5000
+
+    def test_malformed_blobs_fail_loudly(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Mean("x")
+        base = str(tmp_path / sp._key(a))
+        # unknown state type
+        np.savez(
+            base + "-state.npz",
+            __format_version__=np.int64(2),
+            __state_type__=np.str_("EvilState"),
+            __static__=np.str_("{}"),
+            leaf0=np.float64(1.0),
+        )
+        with pytest.raises(ValueError, match="not in the reconstruction registry"):
+            sp.load(a)
+        # wrong leaf count
+        np.savez(
+            base + "-state.npz",
+            __format_version__=np.int64(2),
+            __state_type__=np.str_("MeanState"),
+            __static__=np.str_("{}"),
+            leaf0=np.float64(1.0),
+        )
+        with pytest.raises(ValueError, match="expected 2"):
+            sp.load(a)
+        # unknown static field
+        np.savez(
+            base + "-state.npz",
+            __format_version__=np.int64(2),
+            __state_type__=np.str_("MeanState"),
+            __static__=np.str_('{"bogus": 3}'),
+            leaf0=np.float64(1.0),
+            leaf1=np.int64(2),
+        )
+        with pytest.raises(ValueError, match="static fields"):
+            sp.load(a)
