@@ -289,11 +289,11 @@ class PatternMatch(_RatioAnalyzer):
     supports_host_partial = True
 
     def host_partial(self, ctx) -> NumMatchesAndCount:
-        from ..runners.features import regex_matches
+        from ..runners.features import column_regex_matches
 
         col = ctx.batch.column(self.column)
         rows = ctx.row_mask(self)
-        matches = regex_matches(col.values, col.mask, self.pattern)
+        matches = column_regex_matches(col, self.pattern)
         return NumMatchesAndCount(
             _np_count(np.count_nonzero(rows & matches)),
             _np_count(np.count_nonzero(rows)),

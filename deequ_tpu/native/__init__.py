@@ -25,6 +25,7 @@ native_block_hll_strings = None
 native_block_kll_sample = None
 native_dict_masked_bincount = None
 native_block_kll_pick = None
+native_pattern_match = None
 
 try:  # pragma: no cover - exercised when the native lib is built
     from .lib import (  # noqa: F401
@@ -38,6 +39,7 @@ try:  # pragma: no cover - exercised when the native lib is built
         native_classify_types,
         native_hll_pack_numeric,
         native_hll_pack_strings,
+        native_pattern_match,
         native_string_lengths,
         native_xxhash64_strings,
     )

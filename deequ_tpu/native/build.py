@@ -28,7 +28,7 @@ def build(force: bool = False) -> str:
     tmp = f"{LIBRARY}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        "-o", tmp, SOURCE,
+        "-o", tmp, SOURCE, "-ldl",
     ]
     try:
         result = subprocess.run(cmd, capture_output=True, text=True)

