@@ -96,6 +96,27 @@ def _check_state_version(found: int, kind: str) -> None:
         raise UnsupportedFormatVersionError(kind, found, STATE_FORMAT_VERSION)
 
 
+def _sanitize_namespace_part(part: str) -> str:
+    """One path segment of a state namespace: keep ASCII LOWERCASE
+    alphanumerics, dot and dash; escape everything else — uppercase
+    letters (two tenants differing only in case must stay distinct even
+    on case-insensitive filesystems) and the ``_`` escape character
+    itself — as ``_XX`` per UTF-8 byte. Escapes are fixed-width (two
+    lowercase hex digits per byte), so the mapping is injective.
+    ``.`` / ``..`` segments are prefixed so a namespace cannot traverse
+    out of the store root."""
+    out = []
+    for ch in part:
+        if ch.isascii() and (ch.islower() or ch.isdigit() or ch in ".-"):
+            out.append(ch)
+        else:
+            out.extend(f"_{b:02x}" for b in ch.encode("utf-8"))
+    safe = "".join(out)
+    if safe in (".", ".."):
+        return "_" + safe
+    return safe
+
+
 class StateLoader:
     def load(self, analyzer: Analyzer) -> Optional[Any]:
         raise NotImplementedError
@@ -123,8 +144,23 @@ class InMemoryStateProvider(StateLoader, StatePersister):
         with self._lock:
             self._states[analyzer] = state
 
+    def analyzers(self) -> list:
+        """The analyzers with a persisted state (a long-lived streaming
+        session's cheap "what do I hold" introspection)."""
+        with self._lock:
+            return list(self._states)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._states)
+
+    def clear(self) -> None:
+        """Drop every state — resets a streaming session's history."""
+        with self._lock:
+            self._states.clear()
+
     def __repr__(self) -> str:
-        return f"InMemoryStateProvider({len(self._states)} states)"
+        return f"InMemoryStateProvider({len(self)} states)"
 
 
 class FileSystemStateProvider(StateLoader, StatePersister):
@@ -134,11 +170,27 @@ class FileSystemStateProvider(StateLoader, StatePersister):
     directory or any URI scheme `deequ_tpu.io` supports (``s3://``,
     ``gs://``, ``memory://``, ...), so a multi-host pod can merge
     day-partition states through shared storage the way the reference does
-    through HDFS."""
+    through HDFS.
 
-    def __init__(self, path: str, allow_overwrite: bool = True):
+    ``namespace`` scopes the store to a subdirectory (path separators in it
+    become nesting, every other unsafe character is escaped): the service's
+    streaming sessions use one namespace per (tenant, dataset) so two
+    tenants persisting the SAME analyzer never collide in one key space."""
+
+    def __init__(
+        self,
+        path: str,
+        allow_overwrite: bool = True,
+        namespace: Optional[str] = None,
+    ):
         from .. import io as dio
 
+        if namespace:
+            for part in str(namespace).split("/"):
+                # an EMPTY part still yields a distinct segment ("a//b"
+                # must not collide with "a/b"); "_" cannot collide with a
+                # literal "_" part, which escapes to "_5f"
+                path = dio.join(path, _sanitize_namespace_part(part) or "_")
         self.path = path
         self.allow_overwrite = allow_overwrite
         dio.makedirs(path)

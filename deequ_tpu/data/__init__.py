@@ -280,8 +280,14 @@ class Dataset:
     pyarrow Table (`from_arrow`), Parquet files (`from_parquet`).
     """
 
-    def __init__(self, table: "pa.Table"):
-        table = _maybe_dictionary_encode(table)
+    def __init__(self, table: "pa.Table", *, probe_encoding: bool = True):
+        # derived views (select / casts / the profiler's pass-2 tables) pass
+        # probe_encoding=False: their parent table already ran the 64k-row
+        # cardinality probes and its verdict stands — re-probing every
+        # derived construction costs three count_distinct passes per plain
+        # string column for no new information
+        if probe_encoding:
+            table = _maybe_dictionary_encode(table)
         if any(pa.types.is_dictionary(f.type) for f in table.schema):
             # one table-wide dictionary per column: batch slices then share
             # a stable code space, the contract of the device frequency path
@@ -344,7 +350,7 @@ class Dataset:
         return self._table.to_pandas()
 
     def select(self, names: Sequence[str]) -> "Dataset":
-        return Dataset(self._table.select(list(names)))
+        return Dataset(self._table.select(list(names)), probe_encoding=False)
 
     def dictionary_size(self, name: str) -> Optional[int]:
         """Entry count of an encoded column's table-wide dictionary WITHOUT
@@ -395,7 +401,7 @@ class Dataset:
             )
         if table is self._table:
             return self
-        return Dataset(table)
+        return Dataset(table, probe_encoding=False)
 
     def with_column_cast_to_f64(self, name: str) -> "Dataset":
         """Replace a string column by its parsed-float64 version (profiler
@@ -421,7 +427,7 @@ class Dataset:
                     return None
 
             casted = pa.array([parse(v) for v in col.to_pylist()], type=pa.float64())
-        return Dataset(self._table.set_column(idx, name, casted))
+        return Dataset(self._table.set_column(idx, name, casted), probe_encoding=False)
 
     def random_split(self, train_fraction: float, seed: int = 0) -> ("Dataset", "Dataset"):
         rng = np.random.default_rng(seed)
@@ -429,8 +435,8 @@ class Dataset:
         picks = rng.random(n) < train_fraction
         idx = np.arange(n)
         return (
-            Dataset(self._table.take(pa.array(idx[picks]))),
-            Dataset(self._table.take(pa.array(idx[~picks]))),
+            Dataset(self._table.take(pa.array(idx[picks])), probe_encoding=False),
+            Dataset(self._table.take(pa.array(idx[~picks])), probe_encoding=False),
         )
 
     # -- batching ------------------------------------------------------------

@@ -472,7 +472,14 @@ def _translate_sql_predicate(src: str) -> str:
                 raise ExpressionError(f"unbalanced IN list in {src!r}")
         elif kind == "word" and low in _SQL_WORD_MAP:
             out.append(_SQL_WORD_MAP[low])
-        elif kind == "word" and low in _FUNCTIONS:
+        elif (
+            kind == "word"
+            and low in _FUNCTIONS
+            and k + 1 < len(tokens)
+            and tokens[k + 1] == ("op", "(")
+        ):
+            # a whitelisted function name is only a function when CALLED;
+            # Spark resolves a bare `Length`/`Matches` as a column identifier
             out.append(low)
         else:
             out.append(text)

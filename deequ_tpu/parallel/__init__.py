@@ -34,6 +34,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 ROW_AXIS = "rows"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """`shard_map` across jax versions: the top-level API where present,
+    else `jax.experimental.shard_map` (0.4.x). Replication checking is
+    disabled either way — the merge programs intentionally return
+    per-device values from replicated inputs — but the FLAG NAME also
+    changed (`check_rep` -> `check_vma`) on a different release than the
+    top-level promotion, so each flag spelling is tried rather than keyed
+    off the API location."""
+    if hasattr(jax, "shard_map"):
+        api = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as api
+    try:
+        return api(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return api(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 def make_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D mesh over the row axis (data parallelism over row shards)."""
     if devices is None:
@@ -133,7 +157,7 @@ def sharded_ingest_fold(
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
         program = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_fold,
                 mesh=mesh,
                 in_specs=(
@@ -142,7 +166,6 @@ def sharded_ingest_fold(
                     P(ROW_AXIS),
                 ),
                 out_specs=spec_of(states_stacked),
-                check_vma=False,
             ),
             donate_argnums=0,  # states are dead after the fold, like the
             # single-device _ingest_program — no per-chunk state copies
@@ -263,12 +286,11 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
             return tuple(out)
 
         program = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 merge_program,
                 mesh=mesh,
                 in_specs=(shard_spec,),
                 out_specs=shard_spec,
-                check_vma=False,
             )
         )
         _COLLECTIVE_MERGE_CACHE[cache_key] = program

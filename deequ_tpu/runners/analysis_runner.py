@@ -31,6 +31,18 @@ from .engine import RunMonitor, ScanEngine
 from .exceptions import MetricCalculationException
 
 
+def collect_required_analyzers(checks, required_analyzers=()) -> List[Analyzer]:
+    """Every analyzer a verification run needs: the explicitly required
+    ones plus each check's, in first-encounter order. Shared by the suite,
+    the aggregated-states path and the service plane (which also derives
+    the placement-cache signature from it), so the three can never disagree
+    about what a set of checks computes."""
+    analyzers: List[Analyzer] = list(required_analyzers)
+    for check in checks:
+        analyzers.extend(check.required_analyzers())
+    return analyzers
+
+
 class AnalysisRunner:
     """Static entry points (reference `AnalysisRunner.onData/run`)."""
 

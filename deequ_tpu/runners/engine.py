@@ -14,6 +14,8 @@ observables so tests can assert scan-sharing invariants, not just values.
 
 from __future__ import annotations
 
+import logging
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -27,6 +29,8 @@ from ..analyzers.grouping import FrequenciesAndNumRows, GroupingAnalyzer
 from ..config import DEFAULT_BATCH_SIZE
 from ..data import Dataset
 from .features import FeatureBuilder
+
+_logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -68,6 +72,17 @@ import threading as _threading  # noqa: E402
 
 _MONITOR_LOCK = _threading.Lock()
 
+#: guards _PROGRAM_CACHE's check-then-insert: service workers and the
+#: placement warmer race on the same battery, and a losing duplicate
+#: (executed=False) overwriting the winner would make the battery read as
+#: cold forever after a completed warm
+_PROGRAM_CACHE_LOCK = _threading.Lock()
+
+#: per-thread device-feature-cache bypass: warm runs execute a throwaway
+#: 1-row sample whose padded features must not occupy (or evict from) the
+#: production cache budget
+_CACHE_BYPASS = _threading.local()
+
 
 class _PhaseTimer:
     __slots__ = ("monitor", "phase", "t0")
@@ -92,9 +107,13 @@ class _PhaseTimer:
 #: jit'd fused programs keyed by (analyzer battery, mesh) — analyzers are
 #: frozen dataclasses, so identical batteries across runs reuse the SAME
 #: compiled XLA program instead of re-tracing a fresh closure (re-compiles
-#: cost tens of seconds for large batteries; values are kept for the process
-#: lifetime, the analog of Spark's codegen cache)
-_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+#: cost tens of seconds for large batteries). LRU-bounded so a long-lived
+#: multi-tenant service cycling through many distinct batteries cannot
+#: grow program/device memory monotonically; an evicted battery simply
+#: reads as cold again and re-warms through the placement router.
+from ..utils import BoundedLRU as _BoundedLRU  # noqa: E402
+
+_PROGRAM_CACHE = _BoundedLRU(256)
 
 
 class PackedScanProgram:
@@ -123,6 +142,10 @@ class PackedScanProgram:
     def __init__(self, analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
         self.analyzers = analyzers
         self.mesh = mesh
+        #: True once the fused update has DISPATCHED at least once: jax.jit
+        #: compiles lazily, so mere construction leaves the program cold —
+        #: warmth claims (the service's cache-aware placement) key on this
+        self.executed = False
 
         init_shapes = jax.eval_shape(
             lambda: tuple(a.init_state() for a in analyzers)
@@ -200,7 +223,9 @@ class PackedScanProgram:
         return self._init_jit()
 
     def __call__(self, carry, features: Dict[str, jax.Array]):
-        return self._update(carry, features)
+        out = self._update(carry, features)
+        self.executed = True  # the jit call above traced + compiled
+        return out
 
     def unpack(self, carry) -> Tuple:
         """Packed carry -> ordinary per-analyzer state pytrees (on device)."""
@@ -210,14 +235,110 @@ class PackedScanProgram:
         return self._update._cache_size()
 
 
+def _program_cache_key(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh) -> Tuple:
+    return (analyzers, None if mesh is None else tuple(mesh.devices.flat))
+
+
 def _fused_program(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
-    key = (analyzers, None if mesh is None else tuple(mesh.devices.flat))
-    cached = _PROGRAM_CACHE.get(key)
-    if cached is not None:
+    key = _program_cache_key(analyzers, mesh)
+    # construction is cheap (eval_shape + lazy jit wrappers, no compile),
+    # so holding the lock across it guarantees ONE instance per key — the
+    # instance whose `executed` flag warmth decisions read
+    with _PROGRAM_CACHE_LOCK:
+        cached = _PROGRAM_CACHE.get(key)
+        if cached is None:
+            cached = PackedScanProgram(analyzers, mesh)
+            _PROGRAM_CACHE[key] = cached
         return cached
-    program = PackedScanProgram(analyzers, mesh)
-    _PROGRAM_CACHE[key] = program
-    return program
+
+
+def _deduped_battery(analyzers) -> Tuple[ScanShareableAnalyzer, ...]:
+    """Scan-shareable subset, deduped in first-encounter order — the same
+    normalization do_analysis_run applies before building its battery, so
+    warm registrations and cache probes key consistently with real runs."""
+    return tuple(
+        dict.fromkeys(
+            a for a in analyzers if isinstance(a, ScanShareableAnalyzer)
+        )
+    )
+
+
+def fused_program_is_cached(
+    analyzers: Sequence[ScanShareableAnalyzer], mesh=None
+) -> bool:
+    """Whether the fused scan program for this exact battery has already
+    EXECUTED in this process (jit compiles lazily, so a merely-constructed
+    program would still pay the full XLA compile on its first dispatch —
+    warmth means "a dispatch already happened", not "an object exists").
+    The service's cache-aware placement keys its routing on this."""
+    program = _PROGRAM_CACHE.get(
+        _program_cache_key(_deduped_battery(analyzers), mesh)
+    )
+    return program is not None and program.executed
+
+
+def effective_batch_size(data: Dataset, batch_size: Optional[int] = None) -> int:
+    """The batch size a run over ``data`` will actually use when the
+    caller leaves it unset. (The service plane always passes an EXPLICIT
+    batch size — the bucketed `_session_batch_size` — so its warmth keys
+    key on the shape it dispatches, not on this default.)"""
+    return batch_size or min(DEFAULT_BATCH_SIZE, max(int(data.num_rows), 1))
+
+
+def detached_warm_sample(data: Dataset) -> Dataset:
+    """A 1-row DEEP copy of the dataset for background warming. A zero-copy
+    ``slice(0, 1)`` would keep the parent table's buffers alive for as long
+    as the warm sits queued — with a backlog of multi-second compiles, that
+    pins whole datasets in memory after their jobs finished. The IPC round
+    trip copies only the one row plus each dictionary column's dictionary
+    (which warm battery planning needs)."""
+    import pyarrow as pa
+
+    head = data.arrow.slice(0, 1)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, head.schema) as writer:
+        writer.write_table(head)
+    table = pa.ipc.open_stream(sink.getvalue()).read_all()
+    return Dataset(table, probe_encoding=False)
+
+
+def warm_fused_program(
+    analyzers: Sequence[ScanShareableAnalyzer],
+    mesh=None,
+    data: Optional[Dataset] = None,
+    batch_size: Optional[int] = None,
+) -> None:
+    """Compile the fused scan program for a battery ahead of its first
+    production run. Cold compiles stall a request for tens of seconds (the
+    575x cold-compile gap); the service calls this from a background warmer
+    so queued jobs fall back to the host tier instead of blocking.
+
+    With ``data``, runs the REAL pipeline over a 1-row slice padded to the
+    production batch size, with the FULL analyzer list — grouping analyzers
+    included, so run-time battery augmentations (DeviceFrequencyScan over
+    the dict columns; a slice shares its parent's table-wide dictionary)
+    compile exactly as production will dispatch them. Without ``data`` only
+    the program object is built (registration; the compile stays lazy)."""
+    if data is None:
+        battery = _deduped_battery(analyzers)
+        if battery:
+            _fused_program(battery, mesh)
+        return
+    from .analysis_runner import AnalysisRunner
+
+    sample = Dataset(data.arrow.slice(0, 1), probe_encoding=False)
+    # default to the PRODUCTION batch size: deriving it from ``data`` would
+    # compile a shape-1 program when handed a detached 1-row warm sample,
+    # falsely marking the battery warm at a shape no real run dispatches
+    bs = batch_size or DEFAULT_BATCH_SIZE
+    _CACHE_BYPASS.active = True
+    try:
+        AnalysisRunner.do_analysis_run(
+            sample, list(analyzers), batch_size=bs, sharding=mesh,
+            placement="device",
+        )
+    finally:
+        _CACHE_BYPASS.active = False
 
 
 def _group_leaves(leaves, idx=None) -> Dict[Tuple, List[int]]:
@@ -281,7 +402,7 @@ def _empty_batch_like(data: Dataset, columns):
     """A 0-valid-row batch with the dataset's schema (identity partials)."""
     names = list(columns) if columns is not None else data.schema.names
     empty = data.arrow.slice(0, 0)
-    for b in Dataset(empty).batches(1, columns=names):
+    for b in Dataset(empty, probe_encoding=False).batches(1, columns=names):
         return b
     raise AssertionError("batches() always yields at least one batch")
 
@@ -566,20 +687,115 @@ class _DeviceFeatureCache:
     battery) feature arrays stay in HBM across passes and runs, so a warm
     run over the same dataset streams nothing over the feed link — the
     device-placement analog of a cached columnar scan. Strong table refs
-    pin the id()-based keys; the byte budget simply stops admitting new
-    entries once exhausted (no eviction — the cache exists for bounded
-    bench/warm-run working sets, not arbitrary workloads)."""
+    pin the id()-based keys.
+
+    Entries group by their source TABLE; when the byte budget is exhausted,
+    whole least-recently-used table groups are evicted — dropping the Arrow
+    table pin with them — so a long-lived service rotating across datasets
+    cannot grow host + HBM footprint monotonically. The group currently
+    being admitted is never evicted to make room for itself (evicting batch
+    0 to admit batch N of the same table would thrash every pass); when no
+    other group can be freed, admission stops and that is logged once."""
 
     def __init__(self, budget_bytes: int):
         self.budget = budget_bytes
         self.bytes = 0
         self.store: Dict[Tuple, Dict[str, Any]] = {}
         self.tables: Dict[int, Any] = {}
+        self.evictions = 0
+        #: table-id groups in least-recently-USED-first order
+        self._group_order: "OrderedDict[int, None]" = OrderedDict()
+        self._group_keys: Dict[int, List[Tuple]] = {}
+        self._group_bytes: Dict[int, int] = {}
+        self._admission_stop_logged = False
+        self._lock = _threading.Lock()
+
+    def get(self, key: Tuple) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            features = self.store.get(key)
+            if features is not None:
+                self._group_order.move_to_end(key[0])
+            return features
+
+    def admit(
+        self, key: Tuple, table: Any, features: Dict[str, Any], nbytes: int
+    ) -> bool:
+        """Insert ``features`` under ``key`` (whose first element is the
+        source table's id), evicting LRU table groups as needed. Returns
+        False when the entry cannot fit without evicting its own group."""
+        table_id = key[0]
+        with self._lock:
+            if key in self.store:
+                # two workers prepared the same batch concurrently: keep the
+                # first insert (double-inserting would double-count bytes
+                # and leave a duplicate group key that breaks eviction)
+                self._group_order.move_to_end(table_id)
+                return True
+            if nbytes + self._group_bytes.get(table_id, 0) > self.budget:
+                # no amount of eviction can ever fit this entry (its OWN
+                # group is never evicted for it) — refuse UP FRONT instead
+                # of flushing every other warm group for nothing
+                self._log_admission_stop(nbytes)
+                return False
+            while (
+                self.bytes + nbytes > self.budget
+                and self._evict_lru_group(exclude=table_id)
+            ):
+                pass
+            if self.bytes + nbytes > self.budget:
+                self._log_admission_stop(nbytes)
+                return False
+            self.store[key] = features
+            self.bytes += nbytes
+            self.tables[table_id] = table
+            self._group_keys.setdefault(table_id, []).append(key)
+            self._group_bytes[table_id] = (
+                self._group_bytes.get(table_id, 0) + nbytes
+            )
+            if table_id in self._group_order:
+                self._group_order.move_to_end(table_id)
+            else:
+                self._group_order[table_id] = None
+            return True
+
+    def _log_admission_stop(self, nbytes: int) -> None:
+        if not self._admission_stop_logged:
+            self._admission_stop_logged = True
+            _logger.warning(
+                "device feature cache stopped admitting: entry of %d bytes "
+                "does not fit the %d-byte budget (%d bytes in use by "
+                "unevictable entries); raise %s or expect cold feeds for "
+                "the overflow batches",
+                nbytes, self.budget, self.bytes, DEVICE_FEATURE_CACHE_ENV,
+            )
+
+    def _evict_lru_group(self, exclude: int) -> bool:
+        for table_id in self._group_order:
+            if table_id == exclude:
+                continue
+            del self._group_order[table_id]
+            for key in self._group_keys.pop(table_id):
+                del self.store[key]
+            freed = self._group_bytes.pop(table_id)
+            self.bytes -= freed
+            self.tables.pop(table_id, None)
+            self.evictions += 1
+            _logger.info(
+                "device feature cache evicted table group %d (%d bytes)",
+                table_id, freed,
+            )
+            return True
+        return False
 
     def clear(self) -> None:
-        self.store.clear()
-        self.tables.clear()
-        self.bytes = 0
+        with self._lock:
+            self.store.clear()
+            self.tables.clear()
+            self.bytes = 0
+            self._group_order.clear()
+            self._group_keys.clear()
+            self._group_bytes.clear()
+            self._admission_stop_logged = False
 
 
 #: env var enabling the device feature cache; value = HBM budget in GB
@@ -591,6 +807,8 @@ def device_feature_cache() -> Optional[_DeviceFeatureCache]:
     import os
 
     global _DEVICE_FEATURE_CACHE
+    if getattr(_CACHE_BYPASS, "active", False):
+        return None  # warm-run sample features must not enter the budget
     env = os.environ.get(DEVICE_FEATURE_CACHE_ENV)
     if not env or env == "0":
         return None
@@ -770,6 +988,12 @@ class ScanEngine:
 
         if not analyzers:
             self._update = None
+        elif self._resolve_placement_inner() == "host":
+            # the host tier never dispatches the fused device program;
+            # building it here would register the battery in the program
+            # cache while leaving it uncompiled (jit is lazy), which the
+            # service's cache-aware placement would misread as warm
+            self._update = None
         else:
             self._update = _fused_program(tuple(analyzers), self.mesh)
 
@@ -855,16 +1079,21 @@ class ScanEngine:
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         monitor = self.monitor
         monitor.passes += 1
-        bs = batch_size or min(DEFAULT_BATCH_SIZE, max(int(data.num_rows), 1))
+        bs = effective_batch_size(data, batch_size)
         if self.mesh is not None:
             n_dev = self.mesh.devices.size
             bs = ((bs + n_dev - 1) // n_dev) * n_dev  # shardable batches
         host_states = dict(host_accumulators or {})
         update_fns = host_update_fns or {}
-        if self._update is None and not host_states:
+        has_battery = bool(self.scan_analyzers)
+        if not has_battery and not host_states:
             return [], {}
-        if self._update is not None and self._resolve_placement() == "host":
+        if has_battery and self._resolve_placement() == "host":
             return self._run_host_tier(data, bs, host_states, update_fns, columns)
+        if has_battery and self._update is None:
+            # constructed under a host resolution but asked to run device
+            # (defensive: resolution is deterministic per process)
+            self._update = _fused_program(tuple(self.scan_analyzers), self.mesh)
         # device path: the packed carry IS the state; the pytree states only
         # materialize once, from unpack() after the last batch
         states: Tuple = ()
@@ -897,16 +1126,14 @@ class ScanEngine:
                 return batch, None
             if cache is not None:
                 key = cache_base + (index,)
-                features = cache.store.get(key)
+                features = cache.get(key)
                 if features is None:
                     features = self._prepare(batch)
                     nbytes = sum(v.nbytes for v in features.values())
-                    if cache.bytes + nbytes <= cache.budget:
-                        cache.store[key] = features
-                        cache.bytes += nbytes
-                        # pin the table only once something of it is cached
-                        # (the id()-keyed entries must not outlive the table)
-                        cache.tables[id(data.arrow)] = data.arrow
+                    # admit() pins the table only once something of it is
+                    # cached (the id()-keyed entries must not outlive the
+                    # table) and evicts LRU table groups to make room
+                    cache.admit(key, data.arrow, features, nbytes)
                 return batch, features
             return batch, self._prepare(batch)
 

@@ -1,0 +1,266 @@
+"""Continuous verification service: the layer between
+``VerificationSuite.run()`` and callers.
+
+One process-wide :class:`VerificationService` hosts:
+
+- a multi-tenant **job scheduler** (`scheduler.JobScheduler`): bounded
+  admission with typed load shedding, priority classes, per-job deadlines,
+  retry-with-backoff on transient failures;
+- **streaming micro-batch sessions** (`streaming.StreamingSession`):
+  per-(tenant, dataset) incremental verification over persisted algebraic
+  states, checks evaluated on every merge;
+- **cache-aware placement** (`placement.PlacementRouter`): warm fused
+  batteries run on the device tier, cold ones fall back to the host tier
+  while the device program compiles in the background;
+- an **export plane** (`metrics.ServiceMetrics` / `MetricsExporter`):
+  Prometheus-text and JSON snapshots of per-phase timings, queue depth,
+  retry/shed counts and cache hit rates, fed from each run's RunMonitor.
+
+Usage::
+
+    service = VerificationService(workers=4, max_queue_depth=128)
+    handle = service.submit_verification(data, [check], tenant="team-a")
+    result = handle.result(timeout=60)
+
+    session = service.session("team-a", "clickstream", [check])
+    session.ingest(micro_batch)          # checks evaluated on the merge
+
+    print(service.prometheus_text())
+    service.close()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from ..analyzers import Analyzer
+from ..checks import Check
+from ..data import Dataset
+from .errors import (
+    JobFailed,
+    JobTimeout,
+    ServiceClosed,
+    ServiceError,
+    ServiceOverloaded,
+    SessionClosed,
+    TransientFailure,
+)
+from .metrics import MetricsExporter, ServiceMetrics
+from .placement import (
+    PlacementRouter,
+    battery_signature,
+    shape_qualified_signature,
+)
+from .scheduler import JobContext, JobHandle, JobScheduler, Priority
+from .streaming import StreamingSession, session_key
+
+__all__ = [
+    "VerificationService",
+    "JobScheduler", "JobHandle", "JobContext", "Priority",
+    "StreamingSession",
+    "PlacementRouter", "battery_signature", "shape_qualified_signature",
+    "ServiceMetrics", "MetricsExporter",
+    "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
+    "TransientFailure", "SessionClosed", "ServiceClosed",
+]
+
+
+class VerificationService:
+    """The orchestration facade of the service plane."""
+
+    def __init__(
+        self,
+        workers: int = 4,
+        max_queue_depth: int = 128,
+        *,
+        state_root: Optional[str] = None,
+        mesh=None,
+        background_warm: bool = True,
+        metrics: Optional[ServiceMetrics] = None,
+    ):
+        self.metrics = metrics or ServiceMetrics()
+        self.router = PlacementRouter(
+            self.metrics, mesh=mesh, background_warm=background_warm
+        )
+        self.scheduler = JobScheduler(
+            workers=workers,
+            max_queue_depth=max_queue_depth,
+            metrics=self.metrics,
+            router=self.router,
+        )
+        self.state_root = state_root
+        self.mesh = mesh
+        self._sessions: Dict[Tuple[str, str], StreamingSession] = {}
+        self._sessions_lock = threading.Lock()
+        self._exporter: Optional[MetricsExporter] = None
+
+        def open_sessions() -> int:
+            with self._sessions_lock:  # a scrape must not race session()
+                return sum(1 for s in self._sessions.values() if not s.closed)
+
+        self.metrics.set_gauge_fn(
+            "deequ_service_open_sessions", open_sessions,
+            "Streaming sessions currently accepting micro-batches.",
+        )
+
+    # -- one-shot jobs -------------------------------------------------------
+
+    def submit_verification(
+        self,
+        data: Dataset,
+        checks: Sequence[Check],
+        *,
+        required_analyzers: Sequence[Analyzer] = (),
+        tenant: str = "default",
+        priority: Priority = Priority.NORMAL,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 2,
+        batch_size: Optional[int] = None,
+        metrics_repository: Optional[Any] = None,
+        save_or_append_results_with_key: Optional[Any] = None,
+    ) -> JobHandle:
+        """Queue one verification run; returns immediately with a
+        :class:`JobHandle` (or raises :class:`ServiceOverloaded`)."""
+        from ..runners.analysis_runner import collect_required_analyzers
+        from ..verification import VerificationSuite
+
+        # materialize BEFORE collecting: a one-shot iterable would be
+        # consumed by the signature walk and the job would silently verify
+        # zero checks
+        checks = list(checks)
+        required = list(required_analyzers)
+        analyzers = collect_required_analyzers(checks, required)
+
+        def run(ctx: JobContext):
+            return VerificationSuite.do_verification_run(
+                data,
+                checks,
+                required,
+                metrics_repository=metrics_repository,
+                save_or_append_results_with_key=save_or_append_results_with_key,
+                batch_size=effective_bs,
+                monitor=ctx.monitor,
+                sharding=self.mesh,
+                placement=ctx.placement,
+            )
+
+        from .placement import make_warm_fn
+        from .streaming import _session_batch_size
+
+        # the SAME sizing rule as streaming ingests (power-of-two bucket
+        # clamped to the engine default): jit compiles per shape, so
+        # datasets of wandering row counts must converge on a bounded
+        # shape set. The run below is passed this same explicit batch
+        # size, so the warmth key can never drift from the dispatched
+        # shape.
+        effective_bs = _session_batch_size(int(data.num_rows), batch_size)
+        signature = shape_qualified_signature(analyzers, effective_bs)
+        warm = make_warm_fn(
+            self.router, analyzers, self.mesh, data, effective_bs
+        )
+        return self.scheduler.submit(
+            run,
+            tenant=tenant,
+            priority=priority,
+            deadline_s=deadline_s,
+            max_retries=max_retries,
+            signature=signature,
+            warm_fn=warm,
+        )
+
+    def verify(self, data: Dataset, checks: Sequence[Check], **kw):
+        """Blocking convenience: submit + wait for the result."""
+        timeout = kw.pop("timeout", None)
+        return self.submit_verification(data, checks, **kw).result(timeout)
+
+    # -- streaming sessions --------------------------------------------------
+
+    def session(
+        self, tenant: str, dataset: str, checks: Sequence[Check] = (), **kw
+    ) -> StreamingSession:
+        """Get-or-create the streaming session for (tenant, dataset). On
+        first creation, ``checks`` (and any StreamingSession kwargs) define
+        the session; later calls return the live session unchanged."""
+        key = session_key(tenant, dataset)
+        with self._sessions_lock:
+            existing = self._sessions.get(key)
+            if existing is not None and not existing.closed:
+                return existing
+            if existing is not None and not checks and not kw:
+                # a bare get of a CLOSED session must not silently
+                # recreate it with zero checks and empty state — the
+                # caller would fold batches into a session that verifies
+                # nothing and always reports SUCCESS
+                raise SessionClosed(tenant, dataset)
+            if "state_provider" not in kw and self.state_root is not None:
+                from urllib.parse import quote
+
+                from ..analyzers.state_provider import FileSystemStateProvider
+
+                # quote each component so a "/" INSIDE a tenant or dataset
+                # name cannot alias another (tenant, dataset) pair's
+                # namespace — ("team/a", "x") must not share ("team", "a/x")
+                # — and prefix each so an EMPTY component still yields a
+                # distinct path segment (("", "x") must not share ("x", ""))
+                kw["state_provider"] = FileSystemStateProvider(
+                    self.state_root,
+                    namespace=f"t-{quote(tenant, safe='')}/"
+                    f"d-{quote(dataset, safe='')}",
+                )
+            session = StreamingSession(self, tenant, dataset, checks, **kw)
+            self._sessions[key] = session
+            return session
+
+    # -- export plane --------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        return self.metrics.prometheus_text()
+
+    def json_snapshot(self) -> Dict[str, Any]:
+        return self.metrics.json_snapshot()
+
+    def start_exporter(
+        self, host: str = "127.0.0.1", port: int = 0
+    ) -> MetricsExporter:
+        if self._exporter is not None:
+            if host != self._exporter.host or port not in (
+                0, self._exporter.port
+            ):
+                # silently returning the old binding would leave the
+                # operator scraping a port nothing listens on
+                raise ValueError(
+                    f"metrics exporter already bound to "
+                    f"{self._exporter.host}:{self._exporter.port}; cannot "
+                    f"rebind to {host}:{port}"
+                )
+            return self._exporter
+        self._exporter = MetricsExporter(self.metrics, host=host, port=port)
+        return self._exporter
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, wait: bool = True, timeout: Optional[float] = None) -> None:
+        # drain FIRST: already-admitted folds must complete (shutdown's
+        # "workers drain every pending job" contract) — closing sessions
+        # beforehand would kill queued pipelined ingests with SessionClosed
+        # and silently drop their batches
+        self.scheduler.shutdown(wait=wait, timeout=timeout)
+        # with wait=False (or an expired timeout) folds may still be
+        # queued OR mid-execution on a worker: leave the sessions open so
+        # the daemon workers finish folding them — new ingests are already
+        # rejected typed at the scheduler (ServiceClosed), so nothing
+        # leaks in
+        if self.scheduler.idle():
+            with self._sessions_lock:
+                for session in self._sessions.values():
+                    session.close()
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
+
+    def __enter__(self) -> "VerificationService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

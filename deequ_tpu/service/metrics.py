@@ -1,0 +1,222 @@
+"""The export plane: service observability for an operator.
+
+`ServiceMetrics` aggregates what the per-run :class:`RunMonitor` phase
+timers already measure (`runners/engine.py`) with scheduler-level counters
+(queue depth, retries, sheds, timeouts) and placement-cache hit rates, and
+renders them as either a Prometheus text exposition or a JSON snapshot.
+`MetricsExporter` serves both over HTTP from a background thread — the
+subsystem the one-shot CLI mode never needed and a long-lived service
+cannot run without.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _labels_key(name: str, labels: Dict[str, str]) -> _LabelKey:
+    return name, tuple(sorted(labels.items()))
+
+
+def _escape_snapshot_value(value: str) -> str:
+    """JSON-snapshot series keys join labels with ','/'='; escape those in
+    the value so distinct label sets cannot collide on one key."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace(",", "\\,")
+        .replace("=", "\\=")
+    )
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label escaping: one odd tenant name must not
+    poison the whole exposition (scrapers reject the entire payload)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _render_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class ServiceMetrics:
+    """Thread-safe counter/gauge registry with Prometheus + JSON export.
+
+    Counters are monotonic floats keyed by (name, sorted label items);
+    gauges are CALLABLES evaluated at export time, so queue depth and
+    session counts are always live rather than sampled. Phase timings
+    accumulate under ``deequ_service_phase_seconds_total{phase=...}``
+    straight from each job's ``RunMonitor.phase_seconds``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[_LabelKey, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._help: Dict[str, str] = {}
+
+    # -- registration / update ----------------------------------------------
+
+    def describe(self, name: str, help_text: str) -> None:
+        with self._lock:
+            self._help[name] = help_text
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = _labels_key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def counter_value(self, name: str, **labels: str) -> float:
+        """Current value of one counter series (0.0 when never touched)."""
+        with self._lock:
+            if labels:
+                return self._counters.get(_labels_key(name, labels), 0.0)
+            return sum(v for (n, _), v in self._counters.items() if n == name)
+
+    def set_gauge_fn(
+        self, name: str, fn: Callable[[], float], help_text: Optional[str] = None
+    ) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+            if help_text:
+                self._help[name] = help_text
+
+    def observe_phases(self, phase_seconds: Dict[str, float]) -> None:
+        """Fold one run's ``RunMonitor.phase_seconds`` into the plane."""
+        for phase, seconds in phase_seconds.items():
+            self.inc("deequ_service_phase_seconds_total", seconds, phase=phase)
+
+    # -- export --------------------------------------------------------------
+
+    def _eval_gauges(self) -> Dict[str, float]:
+        out = {}
+        with self._lock:  # snapshot: a scrape must not race set_gauge_fn
+            gauges = list(self._gauges.items())
+        for name, fn in gauges:
+            try:
+                out[name] = float(fn())
+            except Exception:  # noqa: BLE001 - a dead gauge must not kill export
+                out[name] = float("nan")
+        return out
+
+    def json_snapshot(self) -> Dict[str, Any]:
+        """One JSON-able dict of everything the plane knows right now.
+        Non-finite gauge readings (a dead gauge) become ``None`` — a bare
+        NaN token would make the whole payload unparseable to strict JSON
+        parsers."""
+        import math
+        with self._lock:
+            counters = dict(self._counters)
+        series: Dict[str, Any] = {}
+        for (name, labels), value in sorted(counters.items()):
+            if labels:
+                # escape the joiners so arbitrary caller strings (tenant
+                # names) cannot produce ambiguous or colliding series keys
+                series.setdefault(name, {})[
+                    ",".join(
+                        f"{k}={_escape_snapshot_value(v)}" for k, v in labels
+                    )
+                ] = value
+            else:
+                series[name] = value
+        gauges = {
+            name: (value if math.isfinite(value) else None)
+            for name, value in self._eval_gauges().items()
+        }
+        return {"counters": series, "gauges": gauges}
+
+    def json_text(self) -> str:
+        return json.dumps(self.json_snapshot(), sort_keys=True)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            counters = dict(self._counters)
+            help_texts = dict(self._help)
+        lines = []
+        seen_header = set()
+        for (name, labels), value in sorted(counters.items()):
+            if name not in seen_header:
+                seen_header.add(name)
+                if name in help_texts:
+                    lines.append(f"# HELP {name} {help_texts[name]}")
+                lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name}{_render_labels(labels)} {_format(value)}")
+        for name, value in sorted(self._eval_gauges().items()):
+            if name in help_texts:
+                lines.append(f"# HELP {name} {help_texts[name]}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_format(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _format(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"  # int(inf) raises; Prometheus accepts the literal
+    if value == float("-inf"):
+        return "-Inf"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+class MetricsExporter:
+    """Serves ``/metrics`` (Prometheus text) and ``/metrics.json`` from a
+    daemon thread. Binds to an ephemeral port by default (``port=0``); the
+    bound port is on ``.port``."""
+
+    def __init__(
+        self, metrics: ServiceMetrics, host: str = "127.0.0.1", port: int = 0
+    ):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        plane = metrics
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if self.path.startswith("/metrics.json"):
+                    body = plane.json_text().encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = plane.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: the plane IS the log
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="deequ-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)

@@ -1,0 +1,274 @@
+"""Cache-aware placement: route jobs where their compiled programs live.
+
+A battery's fused ``PackedScanProgram`` is cached per process keyed by the
+exact analyzer tuple (`runners/engine.py`). A COLD battery pays a trace +
+XLA compile measured at up to 575x the warm dispatch — long enough that one
+cold job must never stall the queue behind it. The router therefore:
+
+- answers "device" when the battery's fused program is already cached
+  (warm: zero compile in the request path);
+- answers "host" for a cold battery — the host ingest tier runs on small
+  signature-bundled programs that converge across batteries and datasets,
+  so a cold run completes promptly next to the data — while a background
+  warmer builds the device program off the request path;
+- remembers which WORKER ran each signature so the scheduler can prefer
+  handing a battery back to the thread whose device-side working set
+  (feature cache, donation buffers) is already hot.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Sequence, Set, Tuple
+
+_logger = logging.getLogger(__name__)
+
+from ..analyzers.base import Analyzer, ScanShareableAnalyzer
+from .metrics import ServiceMetrics
+
+#: a battery signature: the deduped scan-shareable analyzer tuple, the same
+#: object the engine keys its program cache on
+Signature = Tuple[ScanShareableAnalyzer, ...]
+
+
+def battery_signature(analyzers: Sequence[Analyzer]) -> Signature:
+    """The deduped scan-shareable subset in first-encounter order — the
+    fused battery `do_analysis_run` will build from these analyzers,
+    normalized by the ENGINE's own helper so warmth keys can never drift
+    from program-cache keys.
+
+    This is the warmth KEY, not necessarily the exact compiled battery:
+    data-dependent device-frequency scans join at run time and
+    precondition failures drop analyzers, so the engine's program-cache
+    key can differ. The router therefore also counts a signature warm once
+    a job carrying it has RUN — whatever that run compiled is resident —
+    rather than trusting cache introspection alone."""
+    from ..runners.engine import _deduped_battery
+
+    return _deduped_battery(analyzers)
+
+
+def shape_qualified_signature(
+    analyzers: Sequence[Analyzer], batch_size: int
+) -> Tuple:
+    """``battery_signature`` plus the padded batch size. jit compiles per
+    SHAPE, so warmth must be claimed per (battery, batch size): a battery
+    warm at one shape still cold-compiles at another, and routing it to
+    the device tier would stall a worker on exactly the compile the router
+    exists to keep off the queue. An EMPTY battery (grouping/host-only
+    checks) stays the empty signature — there is nothing to warm, and
+    decide() must keep its no-battery early-out."""
+    battery = battery_signature(analyzers)
+    if not battery:
+        return ()
+    return battery + (("__batch__", int(batch_size)),)
+
+
+def make_warm_fn(
+    router: "PlacementRouter",
+    analyzers: Sequence[Analyzer],
+    mesh,
+    data,
+    batch_size: int,
+) -> Optional[Callable[[], None]]:
+    """The warm closure a submitter hands the scheduler: ``None`` when the
+    battery is already warm at this batch shape (no artifacts built on hot
+    paths); otherwise a thunk that compiles the production-shaped program
+    from a DETACHED 1-row sample, so the queued closure never pins the
+    job's dataset. The single construction point for both one-shot jobs
+    and streaming ingests — the two paths' warmth behavior cannot drift
+    apart."""
+    signature = shape_qualified_signature(analyzers, batch_size)
+    if not signature or router.is_warm(signature):
+        return None
+    from ..runners.engine import detached_warm_sample, warm_fused_program
+
+    sample = detached_warm_sample(data)
+
+    def warm():
+        warm_fused_program(analyzers, mesh, data=sample, batch_size=batch_size)
+
+    return warm
+
+
+class PlacementRouter:
+    def __init__(
+        self,
+        metrics: Optional[ServiceMetrics] = None,
+        mesh=None,
+        background_warm: bool = True,
+    ):
+        self.metrics = metrics or ServiceMetrics()
+        self.mesh = mesh
+        from ..utils import BoundedLRU
+
+        self._lock = threading.Lock()
+        #: worker affinity per signature — bounded like every other
+        #: long-lived structure here, so churned-out batteries' analyzer
+        #: tuples don't stay pinned in host memory forever
+        self._workers_by_sig = BoundedLRU(256)
+        #: warmth evidence from completed device runs/warms. Bounded to the
+        #: same order as the engine's program cache (256): when the LRU
+        #: there evicts a battery, this record ages out around the same
+        #: churn, so an evicted battery eventually reads cold again and
+        #: re-warms in the background instead of stalling a request
+        self._ran = BoundedLRU(256)
+        #: signatures with a warm currently IN FLIGHT (dedup only — every
+        #: terminal path discards, so a cold battery can always re-warm)
+        self._warming: Set[Signature] = set()
+        self._warmer: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="deequ-warmer")
+            if background_warm
+            else None
+        )
+        self.metrics.describe(
+            "deequ_service_placement_cache_hits_total",
+            "Jobs routed to a worker whose fused scan program was already compiled.",
+        )
+        self.metrics.describe(
+            "deequ_service_placement_cache_misses_total",
+            "Jobs whose battery was cold: routed to the host tier while the "
+            "device program compiles in the background.",
+        )
+        self.metrics.describe(
+            "deequ_service_programs_warmed_total",
+            "Background warms that completed (compiled the production-shaped "
+            "fused program).",
+        )
+        self.metrics.describe(
+            "deequ_service_warm_failures_total",
+            "Background warms that raised; the battery stays on the host "
+            "tier (see the service log for the exception).",
+        )
+
+    def is_warm(self, signature: Signature) -> bool:
+        """Side-effect-free warmth probe (no counters, no warm scheduling):
+        submitters use it to skip building warm artifacts for batteries
+        that are already hot. ``signature`` is either a plain battery tuple
+        (engine cache introspection applies, shape-agnostic) or a
+        shape-qualified one from `shape_qualified_signature` (warmth rests
+        purely on completed runs/warms AT THAT SHAPE)."""
+        if not signature:
+            return True
+        if self._ran.get(signature):
+            return True
+        battery = tuple(
+            a for a in signature if isinstance(a, ScanShareableAnalyzer)
+        )
+        if len(battery) == len(signature):
+            from ..runners.engine import fused_program_is_cached
+
+            return fused_program_is_cached(signature, self.mesh)
+        return False
+
+    def decide(
+        self,
+        signature: Signature,
+        warm: Optional[Callable[[], None]] = None,
+    ) -> Optional[str]:
+        """Placement for a job with this battery: ``None`` (engine default /
+        auto) when warm, ``"host"`` when cold. A cold decision also enqueues
+        a background warm — ``warm`` (typically a real 1-padded-batch device
+        run over the job's own data, which compiles the exact production
+        program) or, absent one, a program registration — so the cold
+        window closes after roughly one compile regardless of arrival
+        rate."""
+        if not signature:
+            return None
+        if self.is_warm(signature):  # .get inside refreshes LRU recency
+            self.metrics.inc("deequ_service_placement_cache_hits_total")
+            return None
+        self.metrics.inc("deequ_service_placement_cache_misses_total")
+        if warm is not None and self._warmer is not None:
+            self._warm_in_background(signature, warm)
+        elif self._warmer is None:
+            # background warming is off entirely: shelter THIS job on the
+            # host tier, then let the next one take the device tier's
+            # inline compile — permanently host-routing the battery would
+            # make the device path unreachable forever
+            self._ran[signature] = True
+        # else: a warm-capable service raced warmth eviction between submit
+        # (is_warm said hot, so no warm_fn was built) and pickup — run on
+        # the host tier now WITHOUT faking warmth; the next submission sees
+        # cold and builds a real warm_fn
+        return "host"
+
+    def _warm_in_background(
+        self, signature: Signature, warm: Callable[[], None]
+    ) -> None:
+        with self._lock:
+            if signature in self._warming:
+                return
+            self._warming.add(signature)
+
+        def run_warm():
+            try:
+                warm()
+                # the warm ran the REAL pipeline (full analyzer list,
+                # production batch shape) on the device tier: that is
+                # warmth evidence in its own right, and it covers batteries
+                # whose compiled key drifts from the signature (run-time
+                # device-frequency scans)
+                self._ran[signature] = True
+                self.metrics.inc("deequ_service_programs_warmed_total")
+            except Exception:  # noqa: BLE001 - advisory, but NOT silent: a
+                # persistently failing warm leaves the battery cold forever,
+                # and an operator needs more than a climbing miss counter
+                _logger.warning(
+                    "background warm failed for battery of %d analyzers",
+                    len(signature), exc_info=True,
+                )
+                self.metrics.inc("deequ_service_warm_failures_total")
+            finally:
+                # _warming is an in-flight marker, never a permanent claim:
+                # a battery that goes cold again (warmth aged out, program
+                # evicted) must always be able to re-warm
+                with self._lock:
+                    self._warming.discard(signature)
+
+        try:
+            self._warmer.submit(run_warm)
+        except RuntimeError:
+            # executor already shut down (service closing with jobs still
+            # draining): warming is advisory — never let it kill the
+            # worker that asked for a placement
+            with self._lock:
+                self._warming.discard(signature)
+
+    # -- worker affinity -----------------------------------------------------
+
+    def note_ran(
+        self,
+        signature: Signature,
+        worker_id: int,
+        placement: Optional[str] = None,
+    ) -> None:
+        """Record that ``worker_id`` executed ``signature``. Only a run
+        whose EXECUTED placement was the device tier counts as warmth
+        evidence (its dispatch compiled the fused program, run-time
+        augmentations included) — a host-tier run never builds the device
+        program, and treating it as warm would send the next job straight
+        into the cold compile. Worker affinity records either way."""
+        if not signature:
+            return
+        if placement == "device":
+            self._ran[signature] = True
+        with self._lock:
+            workers = self._workers_by_sig.get(signature)
+            if workers is None:
+                workers = set()
+                self._workers_by_sig[signature] = workers
+            workers.add(worker_id)
+
+    def preferred_workers(self, signature: Signature) -> Set[int]:
+        with self._lock:
+            return set(self._workers_by_sig.get(signature) or ())
+
+    def close(self) -> None:
+        if self._warmer is not None:
+            # cancel queued warms: each is a potential multi-second XLA
+            # compile, and the executor's non-daemon threads would block
+            # interpreter exit until every one finished
+            self._warmer.shutdown(wait=False, cancel_futures=True)

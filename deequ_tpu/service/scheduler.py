@@ -1,0 +1,467 @@
+"""Multi-tenant job scheduler: bounded queue, priorities, deadlines, retry.
+
+The reference deequ runs as one-shot batch jobs; a service hosting repeated
+verification (the production mode of Schelter et al., VLDB 2018) needs an
+admission-controlled queue between callers and the engine. Design points:
+
+- **Bounded admission.** `submit` sheds with a typed
+  :class:`ServiceOverloaded` once `max_queue_depth` jobs are pending —
+  queueing unboundedly only converts an overload into a deadline storm.
+  Retries of already-admitted jobs re-enter without re-admission (the
+  bound can transiently exceed by at most the worker count).
+- **Priority classes.** The ready list stays sorted by (priority,
+  submission sequence): strict priority, FIFO within a class.
+- **Deadlines.** Per-job wall-clock budgets, checked when a worker picks
+  the job up (queued past its deadline -> typed :class:`JobTimeout`
+  without wasting a run) and again at completion.
+- **Typed retry with backoff.** :class:`TransientFailure` (and any
+  `retry_on` types the caller registers) re-enqueues with exponential
+  backoff until the retry budget or the deadline runs out; everything
+  else fails fast as :class:`JobFailed`.
+- **Cache-aware pickup.** Workers prefer ready jobs whose battery they
+  have run before (see `placement.PlacementRouter`), falling back to the
+  global head — soft affinity without starvation.
+
+Workers are threads: every heavy phase of a run (native kernels, numpy,
+pyarrow, device dispatch) releases the GIL, so N workers genuinely overlap
+N jobs' host work the way the engine's own prefetch/partial pools do.
+"""
+
+from __future__ import annotations
+
+import bisect
+import enum
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from ..runners.engine import RunMonitor
+from .errors import (
+    JobFailed,
+    JobTimeout,
+    ServiceClosed,
+    ServiceError,
+    ServiceOverloaded,
+    TransientFailure,
+)
+from .metrics import ServiceMetrics
+from .placement import PlacementRouter, Signature
+
+
+class Priority(enum.IntEnum):
+    """Lower value = served first."""
+
+    HIGH = 0
+    NORMAL = 1
+    LOW = 2
+
+
+@dataclass
+class JobContext:
+    """What a job body receives: identity, attempt number, the worker it
+    landed on, the placement the router chose for this attempt, and a
+    RunMonitor the scheduler harvests into the export plane afterwards —
+    also on failure, so a crashing run still reports its phase costs."""
+
+    job_id: str
+    tenant: str
+    attempt: int
+    worker_id: int
+    placement: Optional[str]
+    monitor: RunMonitor = field(default_factory=RunMonitor)
+
+
+class JobHandle:
+    """Caller-side future for one admitted job."""
+
+    def __init__(self, job_id: str, tenant: str, priority: Priority):
+        self.job_id = job_id
+        self.tenant = tenant
+        self.priority = priority
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self.attempts = 0
+        self.phase_seconds: Dict[str, float] = {}
+        #: the job's value when it COMPLETED but past its deadline (the
+        #: JobTimeout carries completed=True): the work's side effects have
+        #: committed, so the result stays reachable for callers that must
+        #: not re-run committed work
+        self.late_value: Any = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The job's value; raises its typed ServiceError on failure and
+        ``TimeoutError`` if the handle is not done within ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"job {self.job_id} not done after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _finish(self, value: Any, error: Optional[BaseException]) -> None:
+        self._value = value
+        self._error = error
+        self._event.set()
+
+
+class _Job:
+    __slots__ = (
+        "job_id", "fn", "tenant", "priority", "deadline_s", "deadline_abs",
+        "submit_time", "max_retries", "retry_backoff_s", "retry_on",
+        "signature", "handle", "attempts", "seq", "warm_fn", "serial_key",
+    )
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        self.attempts = 0
+
+
+#: ready-queue entries a worker inspects looking for an affinity match
+#: before falling back to the strict head (bounded so pickup stays O(1)-ish)
+_AFFINITY_SCAN = 8
+
+
+class JobScheduler:
+    def __init__(
+        self,
+        workers: int = 4,
+        max_queue_depth: int = 64,
+        metrics: Optional[ServiceMetrics] = None,
+        router: Optional[PlacementRouter] = None,
+        name: str = "deequ-service",
+    ):
+        self.metrics = metrics or ServiceMetrics()
+        self.router = router or PlacementRouter(self.metrics)
+        self.max_queue_depth = int(max_queue_depth)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        #: (priority, seq, job) — ready to run now, kept SORTED by
+        #: (priority, submission seq); _pick scans it front-to-back past
+        #: serial-key-blocked entries, so a heap's pop-only discipline
+        #: would not fit
+        self._ready: List[Tuple[int, int, _Job]] = []
+        #: (not_before, seq, job) — backoff-delayed retries
+        self._delayed: List[Tuple[float, int, _Job]] = []
+        self._seq = itertools.count()
+        self._active = 0
+        self._closed = False
+        #: serial key -> the job currently OWNING it: _pick skips ready
+        #: jobs whose key another job owns, so one streaming session's
+        #: pipelined folds occupy at most ONE worker (instead of parking
+        #: the whole pool on a session lock) and dequeue in FIFO order per
+        #: key. A retried job KEEPS its key through the backoff — releasing
+        #: it would let a later-submitted sibling overtake the retry and
+        #: fold out of order
+        self._running_keys: Dict[Any, _Job] = {}
+        self.metrics.describe(
+            "deequ_service_jobs_submitted_total", "Jobs accepted into the queue."
+        )
+        self.metrics.describe(
+            "deequ_service_jobs_shed_total",
+            "Jobs rejected by admission control (ServiceOverloaded).",
+        )
+        self.metrics.describe(
+            "deequ_service_jobs_completed_total",
+            "Jobs that terminated, by outcome (success/failed/timeout).",
+        )
+        self.metrics.describe(
+            "deequ_service_job_retries_total",
+            "Transient-failure retries that were re-enqueued with backoff.",
+        )
+        self.metrics.set_gauge_fn(
+            "deequ_service_queue_depth", self.pending,
+            "Jobs admitted but not yet running.",
+        )
+        self.metrics.set_gauge_fn(
+            "deequ_service_active_jobs", lambda: self._active,
+            "Jobs currently executing on a worker.",
+        )
+        self._workers = [
+            threading.Thread(
+                target=self._worker_loop, args=(i,),
+                name=f"{name}-worker-{i}", daemon=True,
+            )
+            for i in range(int(workers))
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._ready) + len(self._delayed)
+
+    def idle(self) -> bool:
+        """No job queued, delayed, or EXECUTING — the only state in which
+        it is safe to tear down structures a running job might still
+        touch."""
+        with self._lock:
+            return (
+                not self._ready and not self._delayed and self._active == 0
+            )
+
+    def submit(
+        self,
+        fn: Callable[[JobContext], Any],
+        *,
+        tenant: str = "default",
+        priority: Priority = Priority.NORMAL,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 2,
+        retry_backoff_s: float = 0.05,
+        retry_on: Tuple[Type[BaseException], ...] = (),
+        signature: Signature = (),
+        job_id: Optional[str] = None,
+        warm_fn: Optional[Callable[[], None]] = None,
+        serial_key: Optional[Any] = None,
+    ) -> JobHandle:
+        """Admit one job, or shed it with :class:`ServiceOverloaded`.
+
+        ``warm_fn``, if given, is what the placement router runs in the
+        background when this job's battery is cold (typically a real
+        1-padded-batch device run that compiles the production program).
+        Jobs sharing a ``serial_key`` execute one at a time, in submission
+        order within a priority class — the scheduler-level serialization
+        streaming sessions need, without blocking workers on a lock."""
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed("verification service is shut down")
+            depth = len(self._ready) + len(self._delayed)
+            if depth >= self.max_queue_depth:
+                self.metrics.inc("deequ_service_jobs_shed_total", tenant=tenant)
+                raise ServiceOverloaded(depth, self.max_queue_depth)
+            seq = next(self._seq)
+            now = time.monotonic()
+            jid = job_id or f"{tenant}-{seq}"
+            handle = JobHandle(jid, tenant, priority)
+            job = _Job(
+                job_id=jid, fn=fn, tenant=tenant, priority=priority,
+                deadline_s=deadline_s,
+                deadline_abs=None if deadline_s is None else now + deadline_s,
+                submit_time=now, max_retries=int(max_retries),
+                retry_backoff_s=float(retry_backoff_s),
+                retry_on=tuple(retry_on), signature=signature,
+                handle=handle, seq=seq, warm_fn=warm_fn,
+                serial_key=serial_key,
+            )
+            bisect.insort(self._ready, (int(priority), seq, job))
+            self.metrics.inc("deequ_service_jobs_submitted_total", tenant=tenant)
+            self._cond.notify()
+            return handle
+
+    # -- worker side ---------------------------------------------------------
+
+    def _promote_due(self, now: float) -> None:
+        while self._delayed and self._delayed[0][0] <= now:
+            _, seq, job = heapq.heappop(self._delayed)
+            bisect.insort(self._ready, (int(job.priority), seq, job))
+
+    def _eligible(self, job: _Job) -> bool:
+        """May this job run now? Its serial key must be free — or owned by
+        the job itself (a promoted retry re-entering)."""
+        if job.serial_key is None:
+            return True
+        owner = self._running_keys.get(job.serial_key)
+        return owner is None or owner is job
+
+    def _pick(self, worker_id: int) -> Optional[_Job]:
+        """The best ready job this worker may run, or None when every ready
+        job's serial key is busy (the worker then waits instead of parking
+        on a session lock). ``_ready`` is kept sorted, so this is a single
+        front-to-back scan."""
+        first = None
+        for i, entry in enumerate(self._ready):
+            if self._eligible(entry[2]):
+                first = i
+                break
+        if first is None:
+            return None
+        # soft affinity: among the best few eligible entries of the same
+        # priority class, prefer one whose battery this worker has run
+        # (its device working set is hot). An entry whose serial key
+        # already appeared earlier in the scan is NEVER promoted — affinity
+        # must not reorder same-key siblings (FIFO per key).
+        chosen = first
+        scanned = 0
+        keys_seen: set = set()
+        for j in range(first, len(self._ready)):
+            entry = self._ready[j]
+            if entry[0] != self._ready[first][0] or scanned >= _AFFINITY_SCAN:
+                break
+            job_j = entry[2]
+            if job_j.serial_key is not None:
+                if job_j.serial_key in keys_seen:
+                    continue  # an earlier same-key sibling goes first
+                keys_seen.add(job_j.serial_key)
+            if not self._eligible(job_j):
+                continue
+            scanned += 1
+            if worker_id in self.router.preferred_workers(job_j.signature):
+                chosen = j
+                break
+        job = self._ready.pop(chosen)[2]
+        if job.serial_key is not None:
+            self._running_keys[job.serial_key] = job
+        return job
+
+    def _worker_loop(self, worker_id: int) -> None:
+        while True:
+            with self._cond:
+                job = None
+                while job is None:
+                    now = time.monotonic()
+                    self._promote_due(now)
+                    job = self._pick(worker_id)
+                    if job is not None:
+                        break
+                    if self._closed and not self._delayed and not self._ready:
+                        return
+                    timeout = None
+                    if self._delayed:
+                        timeout = max(self._delayed[0][0] - now, 0.0)
+                    # a finishing job notifies, releasing its serial key
+                    self._cond.wait(timeout)
+                self._active += 1
+            retried = False
+            try:
+                retried = self._execute(job, worker_id)
+            except BaseException as exc:  # noqa: BLE001 - defense in depth:
+                # an error OUTSIDE the job body (router, metrics, harvest)
+                # must neither kill the worker thread nor leave the handle
+                # unresolved forever — "every job terminates with a result
+                # or a typed error" includes scheduler-infrastructure bugs
+                if not job.handle.done():
+                    self._finish(
+                        job, None, JobFailed(job.job_id, job.attempts, exc),
+                        outcome="failed",
+                    )
+            finally:
+                with self._cond:
+                    self._active -= 1
+                    # a retried job keeps OWNING its serial key through the
+                    # backoff: releasing it would let a later-submitted
+                    # sibling overtake the retry and execute out of order
+                    if job.serial_key is not None and not retried:
+                        self._running_keys.pop(job.serial_key, None)
+                    self._cond.notify_all()
+
+    def _execute(self, job: _Job, worker_id: int) -> bool:
+        """Run one job attempt; returns True iff the job was RE-ENQUEUED
+        for retry (the worker then keeps its serial key owned — releasing
+        it would let a later sibling overtake the retry)."""
+        now = time.monotonic()
+        if job.deadline_abs is not None and now > job.deadline_abs:
+            # don't waste a run on a job that already missed its budget
+            self._finish(
+                job, None,
+                JobTimeout(job.job_id, job.deadline_s, now - job.submit_time),
+                outcome="timeout",
+            )
+            return False
+        job.attempts += 1
+        ctx = JobContext(
+            job_id=job.job_id, tenant=job.tenant, attempt=job.attempts,
+            worker_id=worker_id,
+            placement=self.router.decide(job.signature, job.warm_fn),
+        )
+        try:
+            value = job.fn(ctx)
+        except BaseException as exc:  # noqa: BLE001 - routed into the taxonomy
+            self._harvest(job, ctx)
+            if self._maybe_retry(job, exc):
+                return True  # worker keeps the serial key owned (FIFO)
+            if isinstance(exc, ServiceError) and not isinstance(
+                exc, TransientFailure
+            ):
+                self._finish(job, None, exc, outcome="failed")
+            else:
+                self._finish(
+                    job, None, JobFailed(job.job_id, job.attempts, exc),
+                    outcome="failed",
+                )
+            return False
+        self._harvest(job, ctx)
+        # the monitor records the placement the engine actually RESOLVED
+        # (None for jobs that never touched the engine)
+        self.router.note_ran(job.signature, worker_id, ctx.monitor.placement)
+        end = time.monotonic()
+        if job.deadline_abs is not None and end > job.deadline_abs:
+            # the work COMPLETED, just late — its side effects (streaming
+            # state folds, repository saves) have committed, so the result
+            # stays reachable on the handle (late_value) while the caller
+            # gets the typed timeout; discarding it would bait callers into
+            # re-running committed work
+            job.handle.late_value = value
+            self._finish(
+                job, None,
+                JobTimeout(
+                    job.job_id, job.deadline_s, end - job.submit_time,
+                    completed=True,
+                ),
+                outcome="timeout",
+            )
+            return False
+        self._finish(job, value, None, outcome="success")
+        return False
+
+    def _harvest(self, job: _Job, ctx: JobContext) -> None:
+        self.metrics.observe_phases(ctx.monitor.phase_seconds)
+        for phase, seconds in ctx.monitor.phase_seconds.items():
+            job.handle.phase_seconds[phase] = (
+                job.handle.phase_seconds.get(phase, 0.0) + seconds
+            )
+
+    def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:
+        retryable = isinstance(exc, TransientFailure) or (
+            job.retry_on and isinstance(exc, job.retry_on)
+        )
+        if not retryable or job.attempts > job.max_retries:
+            return False
+        delay = job.retry_backoff_s * (2 ** (job.attempts - 1))
+        not_before = time.monotonic() + delay
+        if job.deadline_abs is not None and not_before > job.deadline_abs:
+            return False  # the backoff alone would blow the deadline
+        self.metrics.inc("deequ_service_job_retries_total", tenant=job.tenant)
+        with self._cond:
+            heapq.heappush(self._delayed, (not_before, next(self._seq), job))
+            self._cond.notify()
+        return True
+
+    def _finish(
+        self, job: _Job, value: Any, error: Optional[BaseException], outcome: str
+    ) -> None:
+        self.metrics.inc(
+            "deequ_service_jobs_completed_total",
+            tenant=job.tenant, outcome=outcome,
+        )
+        job.handle.attempts = job.attempts
+        job.handle._finish(value, error)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self, wait: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop intake; workers drain every pending job, then exit."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if wait:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            for t in self._workers:
+                left = (
+                    None if deadline is None
+                    else max(deadline - time.monotonic(), 0.0)
+                )
+                t.join(left)
+        self.router.close()

@@ -1,0 +1,294 @@
+"""Streaming micro-batch ingestion: long-lived per-(tenant, dataset)
+verification sessions.
+
+Schelter et al. (VLDB 2018) frame incremental verification over growing
+datasets as the production mode: don't rescan history, fold each arriving
+delta into persisted ALGEBRAIC states and recompute metrics from the merge.
+A :class:`StreamingSession` is that mode hosted on the TPU engine: every
+micro-batch runs one fused pass over the delta with
+``aggregate_with=save_states_with=<the session's state provider>`` — the
+existing `StateLoader`/`StatePersister` machinery — so after batch N the
+persisted states equal a single batch run over the concatenation of batches
+1..N, and the session's checks are evaluated against the CUMULATIVE metrics
+after every merge: anomalies surface mid-stream, not at end-of-day.
+
+Batches enter through the service scheduler (admission control, deadlines,
+retry, cache-aware placement all apply); merges within one session are
+serialized by a session lock, so concurrent ingests never interleave their
+load-merge-persist cycles.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
+
+from ..analyzers import Analyzer
+from ..analyzers.state_provider import (
+    InMemoryStateProvider,
+    StateLoader,
+    StatePersister,
+)
+from ..checks import Check, CheckStatus
+from ..data import Dataset
+from .errors import SessionClosed
+from .scheduler import JobContext, JobHandle, Priority
+
+
+def _bucket_batch_size(rows: int) -> int:
+    """Micro-batch rows -> the next power of two (floor 1024): every jit
+    compile is shape-specialized, so folding each arriving batch at its raw
+    row count would compile a fresh program per distinct size — padding to
+    at most log2 bucket shapes keeps warmth claims honest for streams whose
+    batch sizes wander."""
+    size = 1024
+    while size < rows:
+        size *= 2
+    return size
+
+
+def _session_batch_size(rows: int, batch_size) -> int:
+    """The fold batch size: caller's choice, else the power-of-two bucket
+    CLAMPED to the engine's default — an oversize micro-batch streams as
+    ordinary engine-sized batches instead of one giant padded shape."""
+    from ..config import DEFAULT_BATCH_SIZE
+
+    return batch_size or min(DEFAULT_BATCH_SIZE, _bucket_batch_size(rows))
+
+
+class StreamingSession:
+    """One tenant's continuously-verified dataset."""
+
+    def __init__(
+        self,
+        service,
+        tenant: str,
+        dataset: str,
+        checks: Sequence[Check],
+        *,
+        required_analyzers: Sequence[Analyzer] = (),
+        state_provider: Optional[Any] = None,
+        priority: Priority = Priority.NORMAL,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 0,
+        batch_size: Optional[int] = None,
+        on_result: Optional[Callable[[Any], None]] = None,
+        keep_results: int = 256,
+    ):
+        # max_retries defaults to 0 because a fold MUTATES persisted state:
+        # a transient failure in the middle of a run can leave some
+        # analyzers' states already merged, and re-running the fold would
+        # double-count the batch. Opt into retries only when the state
+        # provider is transactional for a whole fold. (A failure AFTER the
+        # fold completed — e.g. an on_result callback — is safe either way:
+        # the completed result is memoized per job and never re-folded.)
+        if state_provider is not None and not (
+            isinstance(state_provider, StateLoader)
+            and isinstance(state_provider, StatePersister)
+        ):
+            raise TypeError(
+                "state_provider must be both a StateLoader and a "
+                f"StatePersister, got {type(state_provider).__name__}"
+            )
+        self.service = service
+        self.tenant = tenant
+        self.dataset = dataset
+        self.checks = list(checks)
+        self.required_analyzers = list(required_analyzers)
+        self.provider = state_provider or InMemoryStateProvider()
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.max_retries = max_retries
+        self.batch_size = batch_size
+        self.on_result = on_result
+        self._serial = threading.Lock()  # orders load-merge-persist cycles
+        self._closed = False
+        self._schema = None
+        import itertools
+
+        #: per-SUBMISSION counter for job ids — batches_ingested only moves
+        #: when a fold runs, so pipelined ingests (wait=False) would all
+        #: report the same batch identity in timeouts/failures
+        self._submit_seq = itertools.count()
+        self.batches_ingested = 0
+        self.rows_ingested = 0
+        from collections import deque
+
+        #: the most recent ``keep_results`` batch results — bounded, so a
+        #: session ingesting for weeks cannot grow memory per micro-batch
+        #: (counts live in batches_ingested / the export plane)
+        self.results = deque(maxlen=max(int(keep_results), 1))
+        from ..runners.analysis_runner import collect_required_analyzers
+
+        self._analyzers = collect_required_analyzers(
+            self.checks, self.required_analyzers
+        )
+
+    # -- ingestion -----------------------------------------------------------
+
+    def ingest(
+        self,
+        data: Dataset,
+        *,
+        wait: bool = True,
+        timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        """Fold one micro-batch into the session's persisted states and
+        evaluate the checks on the merged (cumulative) metrics.
+
+        With ``wait=True`` (default) returns the batch's
+        ``VerificationResult``; with ``wait=False`` returns the
+        :class:`JobHandle` so callers can pipeline batches."""
+        if self._closed:
+            raise SessionClosed(self.tenant, self.dataset)
+        done: dict = {}  # per-job memo: a retried job must never re-fold
+        bs = _session_batch_size(int(data.num_rows), self.batch_size)
+
+        def fold(ctx: JobContext):
+            return self._fold_batch(ctx, data, done, bs)
+
+        from .placement import make_warm_fn, shape_qualified_signature
+
+        warm = make_warm_fn(
+            self.service.router, self._analyzers, self.service.mesh, data, bs
+        )
+        handle = self.service.scheduler.submit(
+            fold,
+            tenant=self.tenant,
+            priority=self.priority,
+            deadline_s=deadline_s if deadline_s is not None else self.deadline_s,
+            max_retries=self.max_retries,
+            # per-shape warmth: the bucketed batch size is part of the key
+            signature=shape_qualified_signature(self._analyzers, bs),
+            job_id=f"{self.tenant}/{self.dataset}#{next(self._submit_seq)}",
+            warm_fn=warm,
+            # scheduler-level serialization: one fold at a time per session,
+            # in submission order — pipelined ingests occupy ONE worker and
+            # cannot fold out of order (per-batch anomaly attribution)
+            serial_key=(self.tenant, self.dataset),
+        )
+        if wait:
+            from .errors import JobTimeout
+
+            try:
+                return handle.result(timeout)
+            except JobTimeout:
+                if handle.late_value is not None:
+                    # the fold COMPLETED late: the batch is already merged
+                    # into the persisted states — hand back the committed
+                    # result rather than baiting a double-counting retry
+                    return handle.late_value
+                raise
+        return handle
+
+    def _fold_batch(
+        self, ctx: JobContext, data: Dataset, done: dict, batch_size: int
+    ):
+        from ..verification import VerificationSuite
+
+        if "result" in done:
+            # this job already folded the batch on an earlier attempt —
+            # re-folding would merge the batch into the persisted states a
+            # second time; hand back the memoized committed result
+            return self._notify(done)
+        with self._serial:
+            if self._closed:
+                raise SessionClosed(self.tenant, self.dataset)
+            result = VerificationSuite.do_verification_run(
+                data,
+                self.checks,
+                self.required_analyzers,
+                aggregate_with=self.provider,
+                save_states_with=self.provider,
+                batch_size=batch_size,
+                monitor=ctx.monitor,
+                sharding=self.service.mesh,
+                placement=ctx.placement,
+            )
+            done["result"] = result
+            self._schema = self._schema or data.schema
+            self.batches_ingested += 1
+            self.rows_ingested += int(data.num_rows)
+            self.results.append(result)
+            metrics = self.service.metrics
+            metrics.inc(
+                "deequ_service_stream_batches_total",
+                tenant=self.tenant, dataset=self.dataset,
+            )
+            metrics.inc(
+                "deequ_service_stream_rows_total", float(data.num_rows),
+                tenant=self.tenant, dataset=self.dataset,
+            )
+            if result.status != CheckStatus.SUCCESS:
+                # the mid-stream anomaly signal: a failing merge is visible
+                # on the export plane the moment it happens
+                metrics.inc(
+                    "deequ_service_stream_check_failures_total",
+                    tenant=self.tenant, dataset=self.dataset,
+                    status=result.status.value,
+                )
+        return self._notify(done)
+
+    def _notify(self, done: dict):
+        """Deliver on_result at most once per fold, CONTAINED: by the time
+        the callback runs, the batch is already merged into the persisted
+        states — failing the job for a callback error would discard a
+        committed result and bait the caller into a double-counting
+        re-ingest. Callback failures are logged and counted instead."""
+        result = done["result"]
+        if self.on_result is not None and "notified" not in done:
+            done["notified"] = True
+            try:
+                self.on_result(result)
+            except Exception:  # noqa: BLE001 - advisory delivery
+                _logger.warning(
+                    "on_result callback failed for session %s/%s",
+                    self.tenant, self.dataset, exc_info=True,
+                )
+                self.service.metrics.inc(
+                    "deequ_service_callback_failures_total",
+                    tenant=self.tenant, dataset=self.dataset,
+                )
+        return result
+
+    # -- state-only queries --------------------------------------------------
+
+    def current(self):
+        """Re-evaluate the session's checks from the persisted states alone
+        — no data pass (the `run_on_aggregated_states` mode). Requires at
+        least one ingested batch (the schema comes from it)."""
+        from ..verification import VerificationSuite
+
+        with self._serial:
+            if self._schema is None:
+                raise ValueError(
+                    f"session {self.tenant}/{self.dataset} has no ingested "
+                    "batches yet"
+                )
+            return VerificationSuite.run_on_aggregated_states(
+                self._schema,
+                self.checks,
+                [self.provider],
+                required_analyzers=self.required_analyzers,
+            )
+
+    @property
+    def latest(self):
+        """The most recent batch's VerificationResult (None before any)."""
+        return self.results[-1] if self.results else None
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        with self._serial:
+            self._closed = True
+
+
+def session_key(tenant: str, dataset: str) -> Tuple[str, str]:
+    return (str(tenant), str(dataset))

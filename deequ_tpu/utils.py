@@ -44,10 +44,12 @@ class BoundedLRU:
             return key in self._data
 
     def __len__(self) -> int:
-        return len(self._data)
+        with self._lock:
+            return len(self._data)
 
     def keys(self):
-        return list(self._data.keys())
+        with self._lock:
+            return list(self._data.keys())
 
     def clear(self) -> None:
         with self._lock:

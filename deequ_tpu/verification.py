@@ -99,11 +99,12 @@ class VerificationSuite:
         batch_size: Optional[int] = None,
         monitor: Optional[Any] = None,
         sharding: Optional[Any] = None,
+        placement: Optional[str] = None,
     ) -> VerificationResult:
-        analyzers = list(required_analyzers)
-        for check in checks:
-            for a in check.required_analyzers():
-                analyzers.append(a)
+        from .runners.analysis_runner import collect_required_analyzers
+
+        checks = list(checks)  # evaluate() walks them again after the run
+        analyzers = collect_required_analyzers(checks, required_analyzers)
 
         analysis_results = AnalysisRunner.do_analysis_run(
             data,
@@ -120,6 +121,7 @@ class VerificationSuite:
             batch_size=batch_size,
             monitor=monitor,
             sharding=sharding,
+            placement=placement,
         )
         result = VerificationSuite.evaluate(checks, analysis_results)
         if metrics_repository is not None and save_or_append_results_with_key is not None:
@@ -143,9 +145,10 @@ class VerificationSuite:
     ) -> VerificationResult:
         """Verification from merged persisted states, no data pass
         (reference `VerificationSuite.scala:208-229`)."""
-        analyzers = list(required_analyzers)
-        for check in checks:
-            analyzers.extend(check.required_analyzers())
+        from .runners.analysis_runner import collect_required_analyzers
+
+        checks = list(checks)  # evaluate() walks them again after the run
+        analyzers = collect_required_analyzers(checks, required_analyzers)
         context = AnalysisRunner.run_on_aggregated_states(
             schema,
             analyzers,
@@ -197,6 +200,7 @@ class VerificationRunBuilder:
         self._batch_size: Optional[int] = None
         self._monitor = None
         self._sharding = None
+        self._placement: Optional[str] = None
         self._check_results_path: Optional[str] = None
         self._success_metrics_path: Optional[str] = None
 
@@ -236,6 +240,12 @@ class VerificationRunBuilder:
         self._sharding = sharding
         return self
 
+    def with_placement(self, placement: str) -> "VerificationRunBuilder":
+        """Force the ingest tier: "device", "host", or "auto" (the service's
+        cache-aware router uses this to keep cold compiles off the queue)."""
+        self._placement = placement
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -261,6 +271,7 @@ class VerificationRunBuilder:
             batch_size=self._batch_size,
             monitor=self._monitor,
             sharding=self._sharding,
+            placement=self._placement,
         )
         # URI-aware sinks (reference writes these through Hadoop FileSystem,
         # `VerificationSuite.scala:146-172` / `io/DfsUtils.scala:24-85`)

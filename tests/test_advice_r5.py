@@ -1,0 +1,241 @@
+"""Regression tests for advisor findings (ADVICE.md round 5).
+
+- `utils.py`: BoundedLRU.keys()/__len__ must hold the lock (concurrent
+  get()'s move_to_end could blow up the unlocked iteration).
+- `data/__init__.py`: derived Datasets (select, casts, splits) skip the
+  64k-row dictionary-encoding probes their parent already ran.
+- `runners/engine.py`: _DeviceFeatureCache evicts whole per-table entry
+  groups LRU when the budget is exhausted, dropping the Arrow-table pin,
+  and logs when admission stops.
+
+(The fourth finding — SQL function names shadowing column identifiers —
+is pinned in tests/test_sql_predicates.py::TestFunctionNamesAsColumns.)
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deequ_tpu.utils import BoundedLRU
+
+
+class TestBoundedLRUThreadSafety:
+    def test_keys_and_len_locked_under_concurrent_mutation(self):
+        lru = BoundedLRU(64)
+        stop = threading.Event()
+        errors = []
+
+        def writer(base):
+            i = 0
+            while not stop.is_set():
+                lru[(base, i % 200)] = i
+                lru.get((base, (i * 7) % 200))
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    lru.keys()
+                    len(lru)
+                except RuntimeError as exc:  # pragma: no cover - the bug
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=writer, args=(b,)) for b in range(3)]
+        threads += [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors
+        assert len(lru) <= 64
+
+    def test_plain_semantics_still_hold(self):
+        lru = BoundedLRU(2)
+        lru["a"] = 1
+        lru["b"] = 2
+        assert sorted(lru.keys()) == ["a", "b"]
+        lru.get("a")  # touch: "b" becomes LRU
+        lru["c"] = 3
+        assert sorted(lru.keys()) == ["a", "c"]
+        assert len(lru) == 2
+
+
+class TestDerivedDatasetsSkipProbe:
+    def _counting(self, monkeypatch):
+        import deequ_tpu.data as dmod
+
+        calls = []
+        orig = dmod._maybe_dictionary_encode
+
+        def counting(table):
+            calls.append(table.schema.names)
+            return orig(table)
+
+        monkeypatch.setattr(dmod, "_maybe_dictionary_encode", counting)
+        return calls
+
+    def test_select_cast_split_do_not_reprobe(self, monkeypatch):
+        from deequ_tpu.data import Dataset
+
+        calls = self._counting(monkeypatch)
+        ds = Dataset.from_dict(
+            {
+                "s": np.array(["x", "y", "z"] * 200),
+                "num_str": np.array(["1.5", "2.5"] * 300),
+                "v": np.arange(600, dtype=np.float64),
+            }
+        )
+        assert len(calls) == 1  # the root construction probes once
+        ds.select(["s", "v"])
+        ds.with_column_cast_to_f64("num_str")
+        ds.random_split(0.5, seed=1)
+        ds.with_columns_dictionary_encoded(["v"])
+        assert len(calls) == 1, "derived views must not re-run the probes"
+
+    def test_fresh_roots_still_probe(self, monkeypatch):
+        from deequ_tpu.data import Dataset
+
+        calls = self._counting(monkeypatch)
+        Dataset.from_dict({"s": ["a", "b"] * 50})
+        Dataset.from_dict({"s": ["c", "d"] * 50})
+        assert len(calls) == 2
+
+    def test_derived_dataset_keeps_parent_encoding(self):
+        from deequ_tpu.data import Dataset
+
+        ds = Dataset.from_dict({"s": ["a", "b"] * 400, "v": list(range(800))})
+        assert ds.dictionary_size("s") == 2  # probe encoded the root
+        view = ds.select(["s"])
+        assert view.dictionary_size("s") == 2  # encoding rode the slice
+
+
+class TestDeviceFeatureCacheEviction:
+    def _cache(self, budget):
+        from deequ_tpu.runners.engine import _DeviceFeatureCache
+
+        return _DeviceFeatureCache(budget)
+
+    def test_lru_group_eviction_drops_table_pin(self):
+        cache = self._cache(budget=100)
+        t1, t2, t3 = object(), object(), object()
+        for i in range(2):
+            assert cache.admit((id(t1), i), t1, {"f": i}, 20)
+        assert cache.admit((id(t2), 0), t2, {"f": 0}, 40)
+        assert cache.bytes == 80 and set(cache.tables) == {id(t1), id(t2)}
+        # t1 is LRU -> its WHOLE group (both entries) goes, pin included
+        assert cache.admit((id(t3), 0), t3, {"f": 0}, 60)
+        assert id(t1) not in cache.tables
+        assert cache.get((id(t1), 0)) is None and cache.get((id(t1), 1)) is None
+        assert cache.get((id(t2), 0)) is not None
+        assert cache.bytes == 100 and cache.evictions == 1
+
+    def test_get_refreshes_group_recency(self):
+        cache = self._cache(budget=100)
+        t1, t2, t3 = object(), object(), object()
+        cache.admit((id(t1), 0), t1, {}, 40)
+        cache.admit((id(t2), 0), t2, {}, 40)
+        cache.get((id(t1), 0))  # t1 is now MRU; t2 becomes the victim
+        cache.admit((id(t3), 0), t3, {}, 40)
+        assert id(t1) in cache.tables and id(t2) not in cache.tables
+
+    def test_own_group_never_evicted_for_itself(self, caplog):
+        import logging
+
+        cache = self._cache(budget=50)
+        t1 = object()
+        assert cache.admit((id(t1), 0), t1, {}, 40)
+        with caplog.at_level(logging.WARNING, logger="deequ_tpu.runners.engine"):
+            # the same table's next batch does not fit: admission stops
+            # (evicting batch 0 to admit batch 1 would thrash every pass)
+            assert not cache.admit((id(t1), 1), t1, {}, 40)
+        assert cache.get((id(t1), 0)) is not None
+        assert any(
+            "stopped admitting" in rec.message for rec in caplog.records
+        ), "refused admission must be logged"
+        # ... and logged ONCE, not per batch
+        with caplog.at_level(logging.WARNING, logger="deequ_tpu.runners.engine"):
+            assert not cache.admit((id(t1), 2), t1, {}, 40)
+        stops = [r for r in caplog.records if "stopped admitting" in r.message]
+        assert len(stops) == 1
+
+    def test_oversize_entry_rejected_without_flushing_warm_groups(self):
+        """An entry larger than the whole budget can never fit; trying to
+        evict for it would flush every warm group for nothing."""
+        cache = self._cache(budget=100)
+        t1, t2 = object(), object()
+        assert cache.admit((id(t1), 0), t1, {"f": 0}, 60)
+        assert not cache.admit((id(t2), 0), t2, {"f": 0}, 150)
+        assert cache.get((id(t1), 0)) is not None, "warm group must survive"
+        assert cache.evictions == 0
+
+    def test_unfittable_entry_counts_own_group_before_evicting_others(self):
+        """budget 100: table A holds 80, B holds 15; a new 30-byte A batch
+        can never fit (A's own group is unevictable for it) — B's warm
+        group must survive the refused admission."""
+        cache = self._cache(budget=100)
+        ta, tb = object(), object()
+        assert cache.admit((id(ta), 0), ta, {"f": 0}, 80)
+        assert cache.admit((id(tb), 0), tb, {"f": 0}, 15)
+        assert not cache.admit((id(ta), 1), ta, {"f": 1}, 30)
+        assert cache.get((id(tb), 0)) is not None, "B flushed for nothing"
+        assert cache.evictions == 0
+
+    def test_program_cache_is_bounded(self):
+        from deequ_tpu.runners.engine import _PROGRAM_CACHE
+        from deequ_tpu.utils import BoundedLRU
+
+        assert isinstance(_PROGRAM_CACHE, BoundedLRU)
+        assert _PROGRAM_CACHE.max_size >= 64  # generous but finite
+
+    def test_duplicate_admit_is_idempotent(self):
+        """Two workers preparing the same batch concurrently both admit the
+        same key: bytes must not double-count and the group bookkeeping
+        must stay consistent (a duplicate group key broke eviction)."""
+        cache = self._cache(budget=100)
+        t1, t2 = object(), object()
+        assert cache.admit((id(t1), 0), t1, {"f": 0}, 40)
+        assert cache.admit((id(t1), 0), t1, {"f": 0}, 40)  # the race loser
+        assert cache.bytes == 40
+        cache.admit((id(t2), 0), t2, {"f": 0}, 80)  # forces t1's eviction
+        assert id(t1) not in cache.tables and cache.bytes == 80
+
+    def test_clear_resets_everything(self):
+        cache = self._cache(budget=100)
+        t1 = object()
+        cache.admit((id(t1), 0), t1, {}, 60)
+        cache.clear()
+        assert cache.bytes == 0 and not cache.tables and not cache.store
+        assert cache.admit((id(t1), 0), t1, {}, 60)
+
+    def test_engine_round_trip_with_tiny_budget(self, monkeypatch):
+        """End to end: a warm re-run over the same dataset hits the cache,
+        and a second dataset evicts the first instead of overflowing."""
+        import deequ_tpu.runners.engine as eng
+        from deequ_tpu.analyzers import Mean
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.runners import AnalysisRunner
+
+        # 12KB budget: one 1024-row f64 feature set (~9KB) fits, two don't
+        monkeypatch.setenv(eng.DEVICE_FEATURE_CACHE_ENV, "0.000012")
+        eng.clear_device_feature_cache()
+        try:
+            d1 = Dataset.from_dict({"x": np.arange(1024, dtype=np.float64)})
+            d2 = Dataset.from_dict(
+                {"x": np.arange(1024, 2048, dtype=np.float64)}
+            )
+            AnalysisRunner.do_analysis_run(d1, [Mean("x")], placement="device")
+            cache = eng.device_feature_cache()
+            assert cache is not None and id(d1.arrow) in cache.tables
+            AnalysisRunner.do_analysis_run(d2, [Mean("x")], placement="device")
+            assert id(d1.arrow) not in cache.tables, "LRU table evicted"
+            assert id(d2.arrow) in cache.tables
+            ctx = AnalysisRunner.do_analysis_run(d2, [Mean("x")], placement="device")
+            assert ctx.metric(Mean("x")).value.get() == pytest.approx(1535.5)
+        finally:
+            eng.clear_device_feature_cache()

@@ -132,3 +132,30 @@ class TestExamples:
         n_devices = min(len(jax.devices()), 8)
         assert sharded["Size"] == n_devices * 4096
         assert "all three distribution modes agree" in capsys.readouterr().out
+
+    def test_continuous_verification_example(self, capsys):
+        from examples import continuous_verification_example
+
+        statuses, flaky_handle, shed, snapshot = (
+            continuous_verification_example.main()
+        )
+        # the injected-null batch surfaces its WARNING on that very merge
+        assert statuses == [
+            CheckStatus.SUCCESS, CheckStatus.SUCCESS, CheckStatus.WARNING,
+        ]
+        # the injected transient failure retried once and then succeeded
+        assert flaky_handle.attempts == 2
+        assert flaky_handle.result().status == CheckStatus.SUCCESS
+        # the burst beyond the queue bound was shed, and the export plane
+        # reconciles: accepted - shed, per tenant
+        assert shed > 0
+        counters = snapshot["counters"]
+        assert counters["deequ_service_jobs_shed_total"]["tenant=burst"] == shed
+        assert (
+            counters["deequ_service_stream_batches_total"][
+                "dataset=clickstream,tenant=tenant-a"
+            ]
+            == 3
+        )
+        out = capsys.readouterr().out
+        assert "ServiceOverloaded" in out and "--- /metrics" in out

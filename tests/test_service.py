@@ -1,0 +1,1134 @@
+"""The continuous verification service: scheduler semantics (priorities,
+deadlines, typed retry, admission control), the ≥50-job fault-injection
+soak, streaming micro-batch sessions with algebraic-state parity, the
+cache-aware placement router, and the Prometheus/JSON export plane."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.service import (
+    JobFailed,
+    JobScheduler,
+    JobTimeout,
+    MetricsExporter,
+    PlacementRouter,
+    Priority,
+    ServiceClosed,
+    ServiceMetrics,
+    ServiceOverloaded,
+    SessionClosed,
+    TransientFailure,
+    VerificationService,
+    battery_signature,
+)
+
+
+class TestSchedulerSemantics:
+    def test_priority_classes_strict_order(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+        gate = threading.Event()
+        order = []
+        sched.submit(lambda ctx: gate.wait(30))  # occupy the only worker
+        time.sleep(0.05)  # let the worker take the blocker
+        handles = [
+            sched.submit(lambda ctx: order.append("low"), priority=Priority.LOW),
+            sched.submit(lambda ctx: order.append("normal"), priority=Priority.NORMAL),
+            sched.submit(lambda ctx: order.append("high"), priority=Priority.HIGH),
+        ]
+        gate.set()
+        for h in handles:
+            h.result(30)
+        assert order == ["high", "normal", "low"]
+        sched.shutdown()
+
+    def test_deadline_in_queue_is_typed_timeout_without_running(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+        gate = threading.Event()
+        ran = []
+        sched.submit(lambda ctx: gate.wait(30))
+        time.sleep(0.05)
+        h = sched.submit(lambda ctx: ran.append(1), deadline_s=0.01)
+        time.sleep(0.1)  # deadline passes while queued
+        gate.set()
+        with pytest.raises(JobTimeout):
+            h.result(30)
+        assert ran == []  # the run was never wasted
+        sched.shutdown()
+
+    def test_deadline_during_execution_is_typed_timeout(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+        h = sched.submit(lambda ctx: time.sleep(0.1), deadline_s=0.02)
+        with pytest.raises(JobTimeout) as exc_info:
+            h.result(30)
+        assert exc_info.value.deadline_s == 0.02
+        sched.shutdown()
+
+    def test_transient_failure_retries_with_backoff_then_succeeds(self):
+        sched = JobScheduler(workers=2, max_queue_depth=16)
+        attempts = []
+
+        def flaky(ctx):
+            attempts.append((ctx.attempt, time.monotonic()))
+            if ctx.attempt < 3:
+                raise TransientFailure("injected")
+            return "done"
+
+        h = sched.submit(flaky, max_retries=3, retry_backoff_s=0.02)
+        assert h.result(30) == "done"
+        assert h.attempts == 3
+        # exponential backoff: gap 2 >= 2x base, after gap 1 >= base
+        gaps = [attempts[i + 1][1] - attempts[i][1] for i in range(2)]
+        assert gaps[0] >= 0.02 and gaps[1] >= 0.04
+        assert sched.metrics.counter_value("deequ_service_job_retries_total") == 2
+        sched.shutdown()
+
+    def test_exhausted_retries_become_job_failed_with_cause(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+
+        def always_flaky(ctx):
+            raise TransientFailure("still down")
+
+        h = sched.submit(always_flaky, max_retries=2, retry_backoff_s=0.001)
+        with pytest.raises(JobFailed) as exc_info:
+            h.result(30)
+        assert isinstance(exc_info.value.__cause__, TransientFailure)
+        assert h.attempts == 3  # 1 try + 2 retries
+        sched.shutdown()
+
+    def test_non_retryable_error_fails_fast(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+
+        def broken(ctx):
+            raise ValueError("bad battery")
+
+        h = sched.submit(broken, max_retries=5)
+        with pytest.raises(JobFailed) as exc_info:
+            h.result(30)
+        assert h.attempts == 1  # no retry burned on a permanent error
+        assert isinstance(exc_info.value.__cause__, ValueError)
+        sched.shutdown()
+
+    def test_retry_on_registers_extra_transient_types(self):
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+        attempts = []
+
+        def conn_flaky(ctx):
+            attempts.append(ctx.attempt)
+            if ctx.attempt == 1:
+                raise ConnectionError("reset")
+            return "ok"
+
+        h = sched.submit(
+            conn_flaky, max_retries=2, retry_backoff_s=0.001,
+            retry_on=(ConnectionError,),
+        )
+        assert h.result(30) == "ok" and attempts == [1, 2]
+        sched.shutdown()
+
+    def test_admission_control_sheds_typed(self):
+        sched = JobScheduler(workers=1, max_queue_depth=2)
+        gate = threading.Event()
+        sched.submit(lambda ctx: gate.wait(30))
+        time.sleep(0.05)
+        sched.submit(lambda ctx: None)
+        sched.submit(lambda ctx: None)
+        with pytest.raises(ServiceOverloaded) as exc_info:
+            sched.submit(lambda ctx: None)
+        assert exc_info.value.max_queue_depth == 2
+        assert sched.metrics.counter_value("deequ_service_jobs_shed_total") == 1
+        gate.set()
+        sched.shutdown()
+
+    def test_affinity_never_reorders_same_serial_key(self):
+        """Worker affinity must not promote a later same-serial-key entry
+        past an earlier sibling (FIFO per key beats warm-worker routing)."""
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+        sig1 = battery_signature([Mean("aff_fifo_col_1")])
+        sig2 = battery_signature([Mean("aff_fifo_col_2")])
+        # the lone worker 0 is warm for the SECOND job's battery
+        sched.router.note_ran(sig2, 0, placement="device")
+        gate = threading.Event()
+        order = []
+        sched.submit(lambda ctx: gate.wait(30))
+        time.sleep(0.05)
+        h1 = sched.submit(
+            lambda ctx: order.append(1), signature=sig1, serial_key="k"
+        )
+        h2 = sched.submit(
+            lambda ctx: order.append(2), signature=sig2, serial_key="k"
+        )
+        gate.set()
+        h1.result(30)
+        h2.result(30)
+        assert order == [1, 2], "affinity must not break per-key FIFO"
+        sched.shutdown()
+
+    def test_retry_keeps_serial_key_fifo(self):
+        """A retried serialized job must not let a later-submitted sibling
+        with the same key overtake it during the backoff (streaming: batch
+        N's retry must fold before batch N+1)."""
+        sched = JobScheduler(workers=2, max_queue_depth=16)
+        order = []
+
+        def job_a(ctx):
+            if ctx.attempt == 1:
+                raise TransientFailure("flake")
+            order.append("A")
+            return "A"
+
+        def job_b(ctx):
+            order.append("B")
+            return "B"
+
+        ha = sched.submit(job_a, serial_key="s", max_retries=2,
+                          retry_backoff_s=0.05)
+        hb = sched.submit(job_b, serial_key="s")
+        assert ha.result(30) == "A" and hb.result(30) == "B"
+        assert order == ["A", "B"], "retry must complete before the sibling"
+        sched.shutdown()
+
+    def test_submit_after_shutdown_is_typed(self):
+        sched = JobScheduler(workers=1, max_queue_depth=2)
+        sched.shutdown()
+        with pytest.raises(ServiceClosed):
+            sched.submit(lambda ctx: None)
+
+    def test_completed_late_job_keeps_result_reachable(self):
+        """A job that FINISHES past its deadline has committed its side
+        effects; the typed timeout must say so (completed=True) and the
+        result must stay reachable on the handle."""
+        sched = JobScheduler(workers=1, max_queue_depth=16)
+
+        def late(ctx):
+            time.sleep(0.05)
+            return "committed"
+
+        h = sched.submit(late, deadline_s=0.01)
+        with pytest.raises(JobTimeout) as exc_info:
+            h.result(30)
+        assert exc_info.value.completed is True
+        assert h.late_value == "committed"
+        sched.shutdown()
+
+    def test_streaming_late_fold_returns_committed_result(self):
+        """ingest() must hand back the committed fold when it completes
+        past the deadline — raising would bait a double-counting retry."""
+        service = VerificationService(workers=1, background_warm=False)
+        slow_gate = threading.Event()
+
+        def slow_callback(result):
+            time.sleep(0.08)  # push the fold past its deadline
+            slow_gate.set()
+
+        session = service.session(
+            "a", "late", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()], on_result=slow_callback,
+        )
+        data = Dataset.from_dict({"id": np.arange(50)})
+        result = session.ingest(data, deadline_s=0.05)
+        assert result.metrics[Size()].value.get() == 50.0
+        assert slow_gate.is_set()
+        assert session.current().metrics[Size()].value.get() == 50.0
+        service.close()
+
+
+def _soak_data(seed: int) -> Dataset:
+    rng = np.random.default_rng(seed)
+    return Dataset.from_dict(
+        {"id": np.arange(64) + seed * 1000, "v": rng.normal(0, 1, 64)}
+    )
+
+
+class TestSoak:
+    """≥50 concurrent jobs, mixed priorities, injected timeouts and
+    transient failures: every job terminates with a result or a typed
+    error, the queue stays bounded, and the export plane reconciles with
+    the observed outcomes (ISSUE acceptance criterion 3)."""
+
+    WORKERS = 4
+    MAX_DEPTH = 12
+    TARGET_ACCEPTED = 56
+
+    def test_soak(self):
+        service = VerificationService(
+            workers=self.WORKERS, max_queue_depth=self.MAX_DEPTH,
+            background_warm=False,
+        )
+        sched = service.scheduler
+        check = Check(CheckLevel.ERROR, "soak").is_complete("id")
+        priorities = [Priority.HIGH, Priority.NORMAL, Priority.LOW]
+
+        max_pending = 0
+        stop_sampling = threading.Event()
+
+        def sample_depth():
+            nonlocal max_pending
+            while not stop_sampling.is_set():
+                max_pending = max(max_pending, sched.pending())
+                time.sleep(0.001)
+
+        sampler = threading.Thread(target=sample_depth, daemon=True)
+        sampler.start()
+
+        def sleepy(ctx):
+            time.sleep(0.005)
+            return "slept"
+
+        def transient_once(ctx):
+            if ctx.attempt == 1:
+                raise TransientFailure("injected flake")
+            return "recovered"
+
+        def transient_always(ctx):
+            raise TransientFailure("injected permanent flake")
+
+        def crashy(ctx):
+            raise RuntimeError("injected crash")
+
+        def slow(ctx):  # blows its deadline DURING execution
+            time.sleep(0.05)
+            return "too late"
+
+        handles = []  # (handle, expected_outcome)
+        shed = 0
+        i = 0
+        deadline = time.monotonic() + 60
+        while len(handles) < self.TARGET_ACCEPTED and time.monotonic() < deadline:
+            kind = i % 6
+            prio = priorities[i % 3]
+            i += 1
+            try:
+                if kind == 0:
+                    h = service.submit_verification(
+                        _soak_data(i), [check], tenant=f"t{i % 3}", priority=prio
+                    )
+                    expect = "success"
+                elif kind == 1:
+                    h = sched.submit(sleepy, priority=prio, tenant=f"t{i % 3}")
+                    expect = "success"
+                elif kind == 2:
+                    h = sched.submit(
+                        transient_once, priority=prio, max_retries=2,
+                        retry_backoff_s=0.002,
+                    )
+                    expect = "success"
+                elif kind == 3:
+                    h = sched.submit(
+                        transient_always, priority=prio, max_retries=1,
+                        retry_backoff_s=0.002,
+                    )
+                    expect = "failed"
+                elif kind == 4:
+                    h = sched.submit(crashy, priority=prio)
+                    expect = "failed"
+                else:
+                    h = sched.submit(slow, priority=prio, deadline_s=0.02)
+                    expect = "timeout"
+                handles.append((h, expect))
+            except ServiceOverloaded:
+                shed += 1
+                time.sleep(0.002)  # back off like a real client
+
+        assert len(handles) >= 50, "soak must push >=50 admitted jobs"
+
+        outcomes = {"success": 0, "failed": 0, "timeout": 0}
+        for h, expect in handles:
+            # every handle terminates: a result or a TYPED service error
+            try:
+                h.result(timeout=120)
+                outcome = "success"
+            except JobTimeout:
+                outcome = "timeout"
+            except JobFailed:
+                outcome = "failed"
+            outcomes[outcome] += 1
+            assert outcome == expect, (h.job_id, outcome, expect)
+        stop_sampling.set()
+        sampler.join(5)
+
+        # queue depth stayed bounded: admission holds pending <= max depth;
+        # only in-flight retries may transiently exceed it (by <= workers)
+        assert max_pending <= self.MAX_DEPTH + self.WORKERS
+        assert sched.pending() == 0
+
+        # the export plane reconciles with what we observed
+        m = service.metrics
+        assert m.counter_value("deequ_service_jobs_submitted_total") == len(handles)
+        assert m.counter_value("deequ_service_jobs_shed_total") == shed
+        assert shed > 0, "the soak must actually drive admission control"
+        for outcome, count in outcomes.items():
+            got = sum(
+                v
+                for (name, labels), v in m._counters.items()
+                if name == "deequ_service_jobs_completed_total"
+                and ("outcome", outcome) in labels
+            )
+            assert got == count, (outcome, got, count)
+        # retries: at least one per recovered transient_once job
+        n_once = sum(
+            1 for (h, e) in handles if e == "success" and h.attempts == 2
+        )
+        assert m.counter_value("deequ_service_job_retries_total") >= n_once
+        # phase timings flowed from RunMonitor into the plane
+        snapshot = m.json_snapshot()
+        phases = snapshot["counters"].get("deequ_service_phase_seconds_total", {})
+        assert phases, "verification jobs must export phase timings"
+        verif = [h for (h, e) in handles if e == "success" and h.phase_seconds]
+        assert verif, "successful verification jobs carry per-job phase timers"
+        assert snapshot["gauges"]["deequ_service_queue_depth"] == 0
+        service.close()
+
+
+class TestStreamingSession:
+    def _batch(self, seed: int, rows: int = 200) -> Dataset:
+        rng = np.random.default_rng(seed)
+        return Dataset.from_dict(
+            {
+                "id": np.arange(rows) + seed * 10_000,
+                "v": rng.normal(10.0, 2.0, rows),
+                "cat": np.array(["a", "b", "c", "d"])[rng.integers(0, 4, rows)],
+            }
+        )
+
+    ANALYZERS = ()
+
+    def _analyzers(self):
+        return [
+            Size(), Completeness("v"), Mean("v"), Sum("v"), Minimum("v"),
+            Maximum("v"), StandardDeviation("v"), Uniqueness(["id"]),
+            ApproxCountDistinct("cat"),
+        ]
+
+    def test_three_microbatches_equal_one_concatenated_run(self):
+        """ISSUE acceptance criterion 4: algebraic-state parity, with
+        checks evaluated after every merge."""
+        from deequ_tpu.verification import VerificationSuite
+
+        batches = [self._batch(s) for s in (1, 2, 3)]
+        # cumulative size check: fails exactly on the third merge, proving
+        # checks run against the MERGED states after every batch
+        check = Check(CheckLevel.ERROR, "bounded growth").has_size(
+            lambda n: n <= 450
+        )
+        service = VerificationService(workers=2, background_warm=False)
+        session = service.session(
+            "tenant-x", "events", [check], required_analyzers=self._analyzers()
+        )
+        statuses = [session.ingest(b).status for b in batches]
+        assert statuses == [
+            CheckStatus.SUCCESS, CheckStatus.SUCCESS, CheckStatus.ERROR,
+        ], "the size breach must surface mid-stream on the third merge"
+        assert session.batches_ingested == 3
+        assert session.rows_ingested == 600
+        assert len(session.results) == 3
+
+        concat = Dataset.from_arrow(
+            pa.concat_tables([b.arrow for b in batches])
+        )
+        single = VerificationSuite.do_verification_run(
+            concat, [check], self._analyzers()
+        )
+        streamed = session.results[-1]
+        assert streamed.status == single.status == CheckStatus.ERROR
+
+        single_metrics = {str(a): m for a, m in single.metrics.items()}
+        streamed_metrics = {str(a): m for a, m in streamed.metrics.items()}
+        assert set(single_metrics) == set(streamed_metrics)
+        for name, metric in single_metrics.items():
+            want = metric.value.get()
+            got = streamed_metrics[name].value.get()
+            assert got == pytest.approx(want, rel=1e-9, abs=1e-12), name
+
+        # the state-only re-evaluation agrees too (no data pass)
+        current = session.current()
+        cur_metrics = {str(a): m for a, m in current.metrics.items()}
+        for name in single_metrics:
+            assert cur_metrics[name].value.get() == pytest.approx(
+                single_metrics[name].value.get(), rel=1e-9, abs=1e-12
+            ), name
+        service.close()
+
+    def test_session_get_or_create_and_close(self):
+        service = VerificationService(workers=1, background_warm=False)
+        s1 = service.session("a", "d1", [Check(CheckLevel.ERROR, "c")])
+        s2 = service.session("a", "d1")
+        assert s1 is s2
+        other = service.session("b", "d1")
+        assert other is not s1  # tenants are isolated
+        s1.close()
+        with pytest.raises(SessionClosed):
+            s1.ingest(self._batch(1))
+        # a bare GET of a closed session must not silently recreate it
+        # with zero checks and empty state
+        with pytest.raises(SessionClosed):
+            service.session("a", "d1")
+        s3 = service.session("a", "d1", [Check(CheckLevel.ERROR, "c")])
+        assert s3 is not s1  # explicit recreation with checks is fine
+        service.close()
+
+    def test_pipelined_ingests_fold_in_order_and_spare_the_pool(self):
+        """Scheduler-level serial keys: one session's pipelined folds run
+        one at a time IN SUBMISSION ORDER (per-batch anomaly attribution)
+        and occupy one worker, so other tenants' jobs still run."""
+        service = VerificationService(workers=2, max_queue_depth=32,
+                                      background_warm=False)
+        session = service.session(
+            "a", "ordered", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()],
+        )
+        handles = [
+            session.ingest(
+                Dataset.from_dict({"id": np.arange(25) + i * 25}), wait=False
+            )
+            for i in range(4)
+        ]
+        # with 2 workers and 4 serialized folds pending, another tenant's
+        # job still gets a worker promptly
+        other = service.scheduler.submit(lambda ctx: "ran", tenant="b")
+        assert other.result(30) == "ran"
+        results = [h.result(120) for h in handles]
+        # folds applied in submission order: cumulative sizes are monotone
+        sizes = [r.metrics[Size()].value.get() for r in results]
+        assert sizes == [25.0, 50.0, 75.0, 100.0]
+        service.close()
+
+    def test_detached_warm_sample_copies_one_row(self):
+        """The warm closure must not pin the parent table's buffers."""
+        from deequ_tpu.runners.engine import detached_warm_sample
+
+        data = Dataset.from_dict(
+            {
+                "v": np.arange(1000, dtype=np.float64),
+                "cat": np.array(["a", "b"] * 500),
+            }
+        )
+        sample = detached_warm_sample(data)
+        assert sample.num_rows == 1
+        assert sample.schema.names == data.schema.names
+        # deep copy: the sample's value buffer is NOT the parent's
+        parent_buf = data.arrow["v"].chunk(0).buffers()[1]
+        sample_buf = sample.arrow["v"].chunk(0).buffers()[1]
+        assert sample_buf.address != parent_buf.address
+        # dictionary encoding (and the full dictionary) survives: the warm
+        # battery's device-frequency planning depends on it
+        assert sample.dictionary_size("cat") == data.dictionary_size("cat")
+
+    def test_batch_size_buckets_to_powers_of_two(self):
+        """Variable-size micro-batches must converge on a bounded set of
+        padded shapes (jit compiles per shape); raw row counts would
+        compile a fresh program per distinct size."""
+        from deequ_tpu.service.streaming import _bucket_batch_size
+
+        assert _bucket_batch_size(1) == 1024  # floor
+        assert _bucket_batch_size(500) == 1024
+        assert _bucket_batch_size(1024) == 1024
+        assert _bucket_batch_size(1025) == 2048
+        assert _bucket_batch_size(800_000) == 1 << 20
+
+    def test_session_batch_size_clamps_to_engine_default(self):
+        """An oversize micro-batch must stream as engine-sized batches, not
+        one giant one-off padded shape."""
+        from deequ_tpu.config import DEFAULT_BATCH_SIZE
+        from deequ_tpu.service.streaming import _session_batch_size
+
+        assert _session_batch_size(5_000_000, None) == DEFAULT_BATCH_SIZE
+        assert _session_batch_size(500, None) == 1024
+        assert _session_batch_size(5_000_000, 4096) == 4096
+
+    def test_variable_size_batches_share_bucket_shapes(self):
+        """500-, 800- and 650-row batches all fold at the same padded
+        shape, and parity vs the concatenated run still holds."""
+        from deequ_tpu.verification import VerificationSuite
+
+        service = VerificationService(workers=1, background_warm=False)
+        session = service.session(
+            "a", "varsize", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size(), Mean("v")],
+        )
+        tables = []
+        for i, rows in enumerate((500, 800, 650)):
+            batch = self._batch(i + 1, rows=rows)
+            tables.append(batch.arrow)
+            session.ingest(batch)
+        concat = Dataset.from_arrow(pa.concat_tables(tables))
+        single = VerificationSuite.do_verification_run(
+            concat, [Check(CheckLevel.ERROR, "c")], [Size(), Mean("v")]
+        )
+        assert session.latest.metrics[Size()].value.get() == 1950.0
+        assert session.latest.metrics[Mean("v")].value.get() == pytest.approx(
+            single.metrics[Mean("v")].value.get(), rel=1e-9
+        )
+        service.close()
+
+    def test_pipelined_ingests_get_distinct_job_ids(self):
+        service = VerificationService(workers=1, background_warm=False)
+        session = service.session(
+            "a", "pipe", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()],
+        )
+        h1 = session.ingest(self._batch(1, rows=50), wait=False)
+        h2 = session.ingest(self._batch(2, rows=50), wait=False)
+        assert h1.job_id != h2.job_id
+        h1.result(120)
+        h2.result(120)
+        assert session.batches_ingested == 2
+        service.close()
+
+    def test_current_before_ingest_raises(self):
+        service = VerificationService(workers=1, background_warm=False)
+        session = service.session("a", "empty", [Check(CheckLevel.ERROR, "c")])
+        with pytest.raises(ValueError, match="no ingested batches"):
+            session.current()
+        service.close()
+
+    def test_callback_failure_never_discards_the_committed_fold(self):
+        """By the time on_result runs, the batch is already merged into
+        the persisted states: a callback error must be contained (logged +
+        counted), never fail the job — a JobFailed would bait the caller
+        into a double-counting re-ingest of a committed batch."""
+        calls = []
+
+        def flaky_callback(result):
+            calls.append(result)
+            raise TransientFailure("injected downstream flake")
+
+        service = VerificationService(workers=1, background_warm=False)
+        session = service.session(
+            "a", "refold", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()],
+            on_result=flaky_callback, max_retries=2,
+        )
+        result = session.ingest(self._batch(1, rows=100))  # must not raise
+        assert result.metrics[Size()].value.get() == 100.0
+        assert session.batches_ingested == 1
+        assert len(calls) == 1  # delivery attempted once, failure contained
+        assert service.metrics.counter_value(
+            "deequ_service_callback_failures_total"
+        ) == 1
+        final = session.current()
+        assert final.metrics[Size()].value.get() == 100.0, "batch double-counted"
+        service.close()
+
+    def test_session_namespaces_are_unambiguous(self, tmp_path):
+        """('team/a', 'x') and ('team', 'a/x') must not share one state
+        directory — '/' inside a component is escaped before joining."""
+        service = VerificationService(
+            workers=1, background_warm=False, state_root=str(tmp_path)
+        )
+        check = Check(CheckLevel.ERROR, "c")
+        s1 = service.session("team/a", "x", [check])
+        s2 = service.session("team", "a/x", [check])
+        assert s1.provider.path != s2.provider.path
+        # empty components must stay distinct too: ("", "x") vs ("x", "")
+        s3 = service.session("", "x", [check])
+        s4 = service.session("x", "", [check])
+        assert s3.provider.path != s4.provider.path
+        service.close()
+
+    def test_filesystem_backed_session_namespacing(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        root = str(tmp_path)
+        service = VerificationService(
+            workers=1, background_warm=False, state_root=root
+        )
+        check = Check(CheckLevel.ERROR, "c").is_complete("v")
+        s_a = service.session("team/alpha", "ds", [check])
+        s_b = service.session("team/beta", "ds", [check])
+        assert isinstance(s_a.provider, FileSystemStateProvider)
+        assert s_a.provider.path != s_b.provider.path
+        r1 = s_a.ingest(self._batch(1))
+        assert r1.status == CheckStatus.SUCCESS
+        # the same analyzer persisted by another tenant lands elsewhere
+        s_b.ingest(self._batch(2))
+        analyzer = Completeness("v")
+        assert s_a.provider.load(analyzer) is not None
+        assert s_b.provider.load(analyzer) is not None
+        service.close()
+
+
+class TestPlacementRouter:
+    def test_cold_battery_routes_host_then_warm_routes_device(self):
+        from deequ_tpu.runners.engine import (
+            fused_program_is_cached,
+            warm_fused_program,
+        )
+
+        metrics = ServiceMetrics()
+        router = PlacementRouter(metrics, background_warm=False)
+        battery = battery_signature([Mean("router_cold_col_xyz")])
+        data = Dataset.from_dict(
+            {"router_cold_col_xyz": np.arange(32, dtype=np.float64)}
+        )
+        assert not fused_program_is_cached(battery)
+        assert router.decide(battery) == "host"
+        assert metrics.counter_value(
+            "deequ_service_placement_cache_misses_total"
+        ) == 1
+        # a data-aware warm runs the real pipeline -> the program EXECUTED
+        warm_fused_program(battery, data=data)
+        assert fused_program_is_cached(battery)
+        assert router.decide(battery) is None
+        assert metrics.counter_value(
+            "deequ_service_placement_cache_hits_total"
+        ) == 1
+        router.close()
+
+    def test_construction_alone_is_not_warm(self):
+        """jax.jit compiles lazily: building the program object must not
+        count as warm, or the 'warm' job would pay the cold compile in the
+        request path (code-review finding)."""
+        from deequ_tpu.runners.engine import (
+            _fused_program,
+            fused_program_is_cached,
+        )
+
+        battery = battery_signature([Mean("router_lazy_col_def")])
+        _fused_program(battery, None)  # constructed, never dispatched
+        assert not fused_program_is_cached(battery)
+
+    def test_host_placement_run_does_not_fake_device_warmth(self):
+        """A host-tier run never dispatches the fused device program; it
+        must not register the battery as device-warm."""
+        from deequ_tpu.runners import AnalysisRunner
+        from deequ_tpu.runners.engine import fused_program_is_cached
+
+        analyzer = Mean("router_hostrun_col_ghi")
+        battery = battery_signature([analyzer])
+        data = Dataset.from_dict(
+            {"router_hostrun_col_ghi": np.arange(64, dtype=np.float64)}
+        )
+        AnalysisRunner.do_analysis_run(data, [analyzer], placement="host")
+        assert not fused_program_is_cached(battery)
+        AnalysisRunner.do_analysis_run(data, [analyzer], placement="device")
+        assert fused_program_is_cached(battery)
+
+    def test_background_warmer_closes_cold_window(self):
+        from deequ_tpu.runners.engine import (
+            fused_program_is_cached,
+            warm_fused_program,
+        )
+
+        metrics = ServiceMetrics()
+        router = PlacementRouter(metrics, background_warm=True)
+        battery = battery_signature([Mean("router_warmer_col_abc")])
+        data = Dataset.from_dict(
+            {"router_warmer_col_abc": np.arange(32, dtype=np.float64)}
+        )
+        # cold now; the job-provided warm (as the service wires it) queues
+        assert router.decide(
+            battery, warm=lambda: warm_fused_program(battery, data=data)
+        ) == "host"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if fused_program_is_cached(battery):
+                break
+            time.sleep(0.01)
+        assert fused_program_is_cached(battery)
+        assert router.decide(battery) is None
+        router.close()
+
+    def test_program_cache_single_instance_under_races(self):
+        """Concurrent workers + warmer racing on one battery must share ONE
+        PackedScanProgram — a losing duplicate (executed=False) overwriting
+        the winner would read as cold forever."""
+        from deequ_tpu.runners.engine import _fused_program
+
+        battery = battery_signature([Mean("router_race_col_rr")])
+        results = []
+        barrier = threading.Barrier(6)
+
+        def build():
+            barrier.wait()
+            results.append(_fused_program(battery, None))
+
+        threads = [threading.Thread(target=build) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len({id(p) for p in results}) == 1
+
+    def test_warm_run_bypasses_device_feature_cache(self, monkeypatch):
+        """The warm run's throwaway padded sample must not occupy (or evict
+        from) the production device-feature-cache budget."""
+        import deequ_tpu.runners.engine as eng
+
+        monkeypatch.setenv(eng.DEVICE_FEATURE_CACHE_ENV, "1")
+        eng.clear_device_feature_cache()
+        try:
+            data = Dataset.from_dict(
+                {"router_warmcache_col": np.arange(64, dtype=np.float64)}
+            )
+            eng.warm_fused_program(
+                battery_signature([Mean("router_warmcache_col")]), data=data
+            )
+            cache = eng._DEVICE_FEATURE_CACHE
+            assert cache is None or not cache.store
+        finally:
+            eng.clear_device_feature_cache()
+
+    def test_empty_signature_is_neutral(self):
+        router = PlacementRouter(ServiceMetrics(), background_warm=False)
+        assert router.decide(()) is None
+        router.close()
+
+    def test_ran_signature_counts_warm_despite_cache_key_drift(self):
+        """The engine's real program key can include run-time additions
+        (device-frequency scans) the signature cannot see; once a job with
+        a signature has RUN, the router must report warm instead of
+        routing every future job to the host tier forever."""
+        from deequ_tpu.runners.engine import fused_program_is_cached
+
+        metrics = ServiceMetrics()
+        router = PlacementRouter(metrics, background_warm=False)
+        sig = battery_signature([Mean("router_ran_col_qq")])
+        assert not fused_program_is_cached(sig)
+        # a HOST-tier run never compiled the device program: not warmth
+        router.note_ran(sig, worker_id=0, placement="host")
+        assert not router.is_warm(sig)
+        # a DEVICE-tier run did: its dispatch compiled whatever it needed
+        router.note_ran(sig, worker_id=0, placement="device")
+        assert router.is_warm(sig)
+        assert router.decide(sig) is None
+        assert metrics.counter_value(
+            "deequ_service_placement_cache_hits_total"
+        ) == 1
+        router.close()
+
+    def test_close_drains_pipelined_ingests_before_closing_sessions(self):
+        service = VerificationService(workers=1, max_queue_depth=16,
+                                      background_warm=False)
+        session = service.session(
+            "a", "drain", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()],
+        )
+        handles = [
+            session.ingest(
+                Dataset.from_dict({"id": np.arange(20) + i * 20}), wait=False
+            )
+            for i in range(3)
+        ]
+        service.close()  # must fold all queued batches, not SessionClosed them
+        for h in handles:
+            h.result(1)  # already done; typed error would raise here
+        assert session.batches_ingested == 3
+
+    def test_exporter_rebind_conflict_raises(self):
+        service = VerificationService(workers=1, background_warm=False)
+        exp = service.start_exporter()
+        assert service.start_exporter() is exp  # idempotent default
+        assert service.start_exporter(port=exp.port) is exp
+        with pytest.raises(ValueError, match="already bound"):
+            service.start_exporter(port=exp.port + 1)
+        service.close()
+
+    def test_generator_checks_are_not_silently_consumed(self):
+        """A one-shot iterable of checks must not be exhausted by the
+        signature walk, leaving a job that vacuously succeeds."""
+        service = VerificationService(workers=1, background_warm=False)
+        data = Dataset.from_dict({"id": [1, None, 3]})
+        checks_gen = (
+            c for c in [Check(CheckLevel.ERROR, "gen").is_complete("id")]
+        )
+        result = service.verify(data, checks_gen, timeout=120)
+        assert result.status == CheckStatus.ERROR  # the check actually ran
+        assert len(result.check_results) == 1
+        service.close()
+
+    def test_namespace_sanitizer_is_injective(self):
+        from deequ_tpu.analyzers.state_provider import _sanitize_namespace_part
+
+        assert _sanitize_namespace_part("a*b") != _sanitize_namespace_part("a_2ab")
+        # multi-byte codepoints escape per UTF-8 byte at fixed width, so
+        # '€' (0x20ac) cannot collide with ' ac' (0x20 + literal "ac")
+        assert _sanitize_namespace_part("€") != _sanitize_namespace_part(" ac")
+        assert _sanitize_namespace_part("..") not in (".", "..")
+        assert _sanitize_namespace_part(".") not in (".", "..")
+        assert _sanitize_namespace_part("safe-name.v1") == "safe-name.v1"
+        # uppercase escapes, so "Team" vs "team" stay distinct even on
+        # case-insensitive filesystems (macOS APFS, Windows)
+        team_upper = _sanitize_namespace_part("Team")
+        assert team_upper != _sanitize_namespace_part("team")
+        assert team_upper == team_upper.lower()
+
+    def test_empty_namespace_segments_stay_distinct(self, tmp_path):
+        from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+        root = str(tmp_path)
+        a = FileSystemStateProvider(root, namespace="a//b")
+        b = FileSystemStateProvider(root, namespace="a/b")
+        assert a.path != b.path
+
+    def test_session_results_are_bounded(self):
+        service = VerificationService(workers=1, background_warm=False)
+        session = service.session(
+            "a", "bounded", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()], keep_results=2,
+        )
+        for i in range(4):
+            session.ingest(Dataset.from_dict({"id": np.arange(10) + i * 10}))
+        assert session.batches_ingested == 4
+        assert len(session.results) == 2  # only the freshest results kept
+        assert session.latest.metrics[Size()].value.get() == 40.0
+        service.close()
+
+    def test_aged_out_warmth_reads_cold_and_can_rewarm(self):
+        """Warmth evidence is LRU-bounded alongside the engine's program
+        cache: once it ages out, decide() must answer cold again AND a new
+        background warm must be schedulable (no permanent _warming claim)."""
+        metrics = ServiceMetrics()
+        router = PlacementRouter(metrics, background_warm=False)
+        sig = battery_signature([Mean("router_ageout_col_vv")])
+        router.note_ran(sig, worker_id=0, placement="device")
+        assert router.decide(sig) is None  # warm
+        # simulate LRU churn evicting the warmth record
+        router._ran.clear()
+        assert router.decide(sig) == "host"  # cold again, honestly
+        assert sig not in router._warming or True  # background_warm off
+        router.close()
+
+    def test_failed_warm_is_counted_and_logged(self, caplog):
+        import logging
+
+        metrics = ServiceMetrics()
+        router = PlacementRouter(metrics, background_warm=True)
+        sig = battery_signature([Mean("router_warmfail_col_ww")])
+
+        def broken_warm():
+            raise RuntimeError("injected warm crash")
+
+        with caplog.at_level(logging.WARNING, logger="deequ_tpu.service.placement"):
+            assert router.decide(sig, warm=broken_warm) == "host"
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if metrics.counter_value("deequ_service_warm_failures_total"):
+                    break
+                time.sleep(0.01)
+        assert metrics.counter_value("deequ_service_warm_failures_total") == 1
+        assert any(
+            "background warm failed" in r.getMessage() for r in caplog.records
+        )
+        assert router.decide(sig) == "host"  # still honestly cold
+        router.close()
+
+    def test_json_snapshot_escapes_label_joiners(self):
+        m = ServiceMetrics()
+        m.inc("deequ_join_total", tenant="team-a,outcome=success")
+        m.inc("deequ_join_total", tenant="team-a", outcome="success")
+        snap = m.json_snapshot()["counters"]["deequ_join_total"]
+        assert len(snap) == 2, "distinct label sets must not collide"
+
+    def test_worker_affinity_bookkeeping(self):
+        router = PlacementRouter(ServiceMetrics(), background_warm=False)
+        sig = battery_signature([Mean("affinity_col")])
+        assert router.preferred_workers(sig) == set()
+        router.note_ran(sig, 2)
+        router.note_ran(sig, 0)
+        assert router.preferred_workers(sig) == {0, 2}
+        router.close()
+
+    def test_warmth_is_shape_qualified(self):
+        """jit compiles per batch shape: warmth at one shape must not route
+        a different shape to the device tier's cold compile."""
+        from deequ_tpu.service import shape_qualified_signature
+
+        router = PlacementRouter(ServiceMetrics(), background_warm=False)
+        analyzers = [Mean("router_shape_col_ss")]
+        small = shape_qualified_signature(analyzers, 1024)
+        large = shape_qualified_signature(analyzers, 4096)
+        router.note_ran(small, 0, placement="device")
+        assert router.decide(small) is None  # warm at 1024
+        assert router.decide(large) == "host"  # still cold at 4096
+        router.close()
+
+    def test_no_warmer_shelters_one_job_then_allows_device(self):
+        """With background warming OFF there is no warm mechanism at all:
+        the battery shelters ONE job on the host tier and then takes the
+        device tier (otherwise the device path would be unreachable)."""
+        router = PlacementRouter(ServiceMetrics(), background_warm=False)
+        sig = battery_signature([Mean("router_nochurn_col_nn")])
+        assert router.decide(sig) == "host"
+        assert router.decide(sig) is None  # next job may use the device
+        router.close()
+
+    def test_warm_capable_router_does_not_fake_warmth_for_warmless_jobs(self):
+        """On a warm-capable service, a job arriving without a warm_fn
+        (warmth raced eviction between submit and pickup) runs host WITHOUT
+        marking warm — the next submission rebuilds a real warm_fn instead
+        of the following job eating the inline device compile."""
+        router = PlacementRouter(ServiceMetrics(), background_warm=True)
+        sig = battery_signature([Mean("router_raced_col_mm")])
+        assert router.decide(sig) == "host"
+        assert sig not in router._warming  # nothing useless queued
+        assert router.decide(sig) == "host"  # still honestly cold
+        router.close()
+
+    def test_decide_after_router_close_does_not_raise(self):
+        """A worker asking for placement while the service is draining must
+        never die on the shut-down warmer executor (a dead worker leaves
+        its job's handle unresolved forever)."""
+        from deequ_tpu.runners.engine import warm_fused_program
+
+        router = PlacementRouter(ServiceMetrics(), background_warm=True)
+        router.close()  # executor shut down, jobs may still be draining
+        sig = battery_signature([Mean("router_closed_col_cc")])
+        data = Dataset.from_dict(
+            {"router_closed_col_cc": np.arange(8, dtype=np.float64)}
+        )
+        placement = router.decide(
+            sig, warm=lambda: warm_fused_program(sig, data=data)
+        )
+        assert placement == "host"  # still a safe answer, no exception
+        assert sig not in router._warming  # slot not leaked
+
+    def test_signature_dedupes_and_filters(self):
+        sig = battery_signature(
+            [Mean("x"), Mean("x"), Size(), Uniqueness(["x"])]
+        )
+        # duplicates collapse; the grouping analyzer is not scan-shareable
+        assert sig == (Mean("x"), Size())
+
+    def test_empty_battery_has_empty_shape_signature(self):
+        """Grouping/host-only check sets have nothing to warm: the shape
+        qualifier must not turn the empty battery into a phantom-cold
+        signature that miscounts misses and schedules pointless warms."""
+        from deequ_tpu.service import shape_qualified_signature
+        from deequ_tpu.service.placement import make_warm_fn
+
+        sig = shape_qualified_signature([Uniqueness(["x"])], 2048)
+        assert sig == ()
+        router = PlacementRouter(ServiceMetrics(), background_warm=True)
+        assert router.decide(sig) is None  # no battery, no routing opinion
+        data = Dataset.from_dict({"x": [1, 2]})
+        assert make_warm_fn(router, [Uniqueness(["x"])], None, data, 2048) is None
+        router.close()
+
+    def test_close_without_wait_does_not_drop_queued_folds(self):
+        """close(wait=False) must leave sessions open so queued pipelined
+        ingests still fold (daemon workers keep draining); closing them
+        would silently drop admitted batches."""
+        service = VerificationService(workers=1, max_queue_depth=16,
+                                      background_warm=False)
+        session = service.session(
+            "a", "nodrop", [Check(CheckLevel.ERROR, "c")],
+            required_analyzers=[Size()],
+        )
+        handles = [
+            session.ingest(
+                Dataset.from_dict({"id": np.arange(10) + i * 10}), wait=False
+            )
+            for i in range(3)
+        ]
+        service.close(wait=False)
+        for h in handles:
+            h.result(120)  # every admitted batch folded, none SessionClosed
+        assert session.batches_ingested == 3
+
+
+class TestExportPlane:
+    def test_prometheus_text_format(self):
+        m = ServiceMetrics()
+        m.describe("deequ_test_total", "A test counter.")
+        m.inc("deequ_test_total", 2, tenant="a")
+        m.inc("deequ_test_total", tenant="b")
+        m.set_gauge_fn("deequ_test_gauge", lambda: 7, "A test gauge.")
+        text = m.prometheus_text()
+        assert "# HELP deequ_test_total A test counter." in text
+        assert "# TYPE deequ_test_total counter" in text
+        assert 'deequ_test_total{tenant="a"} 2' in text
+        assert 'deequ_test_total{tenant="b"} 1' in text
+        assert "# TYPE deequ_test_gauge gauge" in text
+        assert "deequ_test_gauge 7" in text
+
+    def test_label_values_are_escaped(self):
+        m = ServiceMetrics()
+        m.inc("deequ_escape_total", tenant='team"a\\b\nc')
+        text = m.prometheus_text()
+        assert 'tenant="team\\"a\\\\b\\nc"' in text
+        assert "\nc\"" not in text  # no raw newline inside a label value
+
+    def test_infinite_gauge_renders_inf_not_crash(self):
+        m = ServiceMetrics()
+        m.set_gauge_fn("deequ_inf_gauge", lambda: float("inf"))
+        m.set_gauge_fn("deequ_ninf_gauge", lambda: float("-inf"))
+        text = m.prometheus_text()  # must not raise OverflowError
+        assert "deequ_inf_gauge +Inf" in text
+        assert "deequ_ninf_gauge -Inf" in text
+        snap = json.loads(m.json_text())  # JSON stays strictly parseable
+        assert snap["gauges"]["deequ_inf_gauge"] is None
+
+    def test_dead_gauge_exports_nan_not_crash(self):
+        m = ServiceMetrics()
+
+        def dead():
+            raise RuntimeError("gone")
+
+        m.set_gauge_fn("deequ_dead_gauge", dead)
+        assert "deequ_dead_gauge NaN" in m.prometheus_text()
+        # ... and the JSON side stays strictly parseable (bare NaN is not
+        # valid JSON): the dead gauge reads as null
+        snap = json.loads(m.json_text())
+        assert snap["gauges"]["deequ_dead_gauge"] is None
+
+    def test_json_snapshot_structure(self):
+        m = ServiceMetrics()
+        m.inc("deequ_jobs_total", 3, outcome="success")
+        m.inc("deequ_plain_total")
+        m.set_gauge_fn("deequ_depth", lambda: 4)
+        snap = m.json_snapshot()
+        assert snap["counters"]["deequ_jobs_total"] == {"outcome=success": 3}
+        assert snap["counters"]["deequ_plain_total"] == 1
+        assert snap["gauges"]["deequ_depth"] == 4
+        json.dumps(snap)  # JSON-able end to end
+
+    def test_http_exporter_serves_both_endpoints(self):
+        m = ServiceMetrics()
+        m.inc("deequ_http_test_total", 5)
+        exporter = MetricsExporter(m)
+        try:
+            base = f"http://127.0.0.1:{exporter.port}"
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "deequ_http_test_total 5" in text
+            snap = json.loads(
+                urllib.request.urlopen(f"{base}/metrics.json").read()
+            )
+            assert snap["counters"]["deequ_http_test_total"] == 5
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/other")
+        finally:
+            exporter.close()
+
+    def test_service_snapshot_reflects_job_counts(self):
+        service = VerificationService(workers=1, background_warm=False)
+        check = Check(CheckLevel.ERROR, "c").is_complete("id")
+        data = Dataset.from_dict({"id": [1, 2, 3]})
+        assert service.verify(data, [check], timeout=120).status == (
+            CheckStatus.SUCCESS
+        )
+        snap = service.json_snapshot()
+        submitted = snap["counters"]["deequ_service_jobs_submitted_total"]
+        assert submitted == {"tenant=default": 1}
+        assert "deequ_service_phase_seconds_total" in snap["counters"]
+        prom = service.prometheus_text()
+        assert 'deequ_service_jobs_completed_total{outcome="success"' in prom
+        service.close()

@@ -133,6 +133,37 @@ class TestSqlLiteralEdgeCases:
         assert ev("x in ('abc')", cols).tolist() == [True, False]
 
 
+class TestFunctionNamesAsColumns:
+    """ADVICE r5 `expr.py:475`: a bare word matching a whitelisted function
+    name (Length, Matches, Abs, ...) is a COLUMN identifier unless it is
+    actually called — Spark resolves unquoted identifiers as columns."""
+
+    def test_column_named_length(self):
+        cols = {"Length": np.array([1.0, 10.0, 3.0])}
+        assert ev("Length > 2", cols).tolist() == [False, True, True]
+
+    def test_column_named_matches_in_sql_expression(self):
+        cols = {"Matches": np.array([0.0, 5.0]), "x": np.array([1.0, 1.0])}
+        assert ev("Matches = 5 AND x = 1", cols).tolist() == [False, True]
+
+    def test_function_call_still_translates(self):
+        cols = {"s": np.array(["ab", "abcd"], dtype=object)}
+        assert ev("LENGTH(s) > 3", cols).tolist() == [False, True]
+
+    def test_column_and_call_coexist(self):
+        cols = {
+            "Length": np.array([9.0, 1.0]),
+            "s": np.array(["ab", "abcd"], dtype=object),
+        }
+        assert ev("Length > 5 OR LENGTH(s) > 3", cols).tolist() == [True, True]
+
+    def test_end_to_end_compliance_on_length_column(self):
+        data = Dataset.from_dict({"Length": [1.0, 2.0, 30.0, 40.0]})
+        a = Compliance("len-rule", "Length >= 10")
+        ctx = AnalysisRunner.do_analysis_run(data, [a])
+        assert ctx.metric(a).value.get() == pytest.approx(0.5)
+
+
 class TestStateStaticFieldsExact:
     def test_missing_static_field_fails_loudly(self, tmp_path):
         from deequ_tpu.analyzers import Mean
