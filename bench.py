@@ -30,6 +30,60 @@ def log(msg: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# per-stage hard deadlines (VERDICT r5 weak #1: the driver's wall-clock kill
+# must never erase completed stages' numbers — each stage now gets its own
+# enforced budget and a graceful skip leaves the partial JSON intact)
+# ---------------------------------------------------------------------------
+
+STAGE_BUDGET_ENV = "DEEQU_TPU_BENCH_STAGE_BUDGET_S"
+
+
+class StageDeadline(BaseException):
+    """A stage blew its wall-clock budget (raised from SIGALRM).
+    BaseException, so no stage-internal ``except Exception`` can swallow
+    the deadline — the same reason KeyboardInterrupt sits outside
+    Exception."""
+
+
+def stage_budget_s() -> float:
+    import os
+
+    return float(os.environ.get(STAGE_BUDGET_ENV, "180"))
+
+
+def run_stage_with_deadline(name: str, fn, *args, **kwargs):
+    """Run one stage under a HARD wall-clock deadline: SIGALRM interrupts
+    the main thread mid-stage (numpy/pyarrow/XLA dispatch all return to the
+    interpreter frequently enough for delivery), the stage is recorded as
+    ``skipped_deadline`` and the bench moves on — a slow stage costs its
+    own numbers, never the stages after it. Returns (result | None,
+    status, seconds)."""
+    import signal
+
+    budget = stage_budget_s()
+
+    def on_alarm(signum, frame):
+        raise StageDeadline(name)
+
+    prior = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, budget)
+    t0 = time.perf_counter()
+    try:
+        result = fn(*args, **kwargs)
+        return result, "ok", time.perf_counter() - t0
+    except StageDeadline:
+        elapsed = time.perf_counter() - t0
+        log(
+            f"[{name}] exceeded its {budget:.0f}s stage budget after "
+            f"{elapsed:.1f}s — skipped (partial JSON keeps earlier stages)"
+        )
+        return None, "skipped_deadline", elapsed
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prior)
+
+
+# ---------------------------------------------------------------------------
 # stage 1: scan battery (BASELINE config 2)
 # ---------------------------------------------------------------------------
 
@@ -459,10 +513,33 @@ def run_device_profile_stage(target_rows: int | None = None) -> dict:
     )
 
     bytes_per_row = 150.0  # pass-1 features at lineitem shape
+    compile_probe_s = 0.0
     if target_rows is None:
-        budget_s = float(os.environ.get("DEEQU_TPU_BENCH_STAGE_BUDGET_S", "180"))
+        budget_s = stage_budget_s()
         bw = probe_feed_bandwidth()
-        target_rows = int(bw * 1e6 * budget_s / bytes_per_row)
+        # MEASURED 1-batch compile probe (VERDICT r5 weak #1b): run the
+        # device-placed profile once over a single production-shaped batch
+        # and charge the measured time — dominated by XLA compile — against
+        # the stage budget. The old model budgeted feed bytes only and the
+        # staging run blew a 180s budget by 6x of pure compile. The probe
+        # doubles as the warmup: the staging run below reuses its programs.
+        probe_table = build_lineitem_data(1 << 20)
+        t0 = time.perf_counter()
+        (
+            ColumnProfilerRunner.on_data(Dataset.from_arrow(probe_table))
+            .with_placement("device")
+            .with_batch_size(1 << 20)
+            .run()
+        )
+        compile_probe_s = time.perf_counter() - t0
+        del probe_table
+        feed_budget_s = max(budget_s - compile_probe_s, 0.1 * budget_s)
+        target_rows = int(bw * 1e6 * feed_budget_s / bytes_per_row)
+        log(
+            f"[device-profile] compile probe: {compile_probe_s:.1f}s for 1 "
+            f"batch (budget {budget_s:.0f}s -> {feed_budget_s:.0f}s left "
+            f"for feed at {bw:.0f} MB/s)"
+        )
     rows = max(2 << 20, min(target_rows, 32 << 20))
     rows = (rows >> 20) << 20  # whole 1M-row batches
     log(f"[device-profile] building {rows:,}-row lineitem table (16 cols)")
@@ -530,7 +607,12 @@ def run_device_profile_stage(target_rows: int | None = None) -> dict:
         f"metrics parity-checked vs numpy/arrow oracles)"
     )
     log(f"[device-profile] phases: {phases}")
-    return {"rows_per_sec": rate, "rows": rows, "stage_seconds": stage_s}
+    return {
+        "rows_per_sec": rate,
+        "rows": rows,
+        "stage_seconds": stage_s,
+        "compile_probe_seconds": compile_probe_s,
+    }
 
 
 def run_device_merge_stage(
@@ -840,31 +922,51 @@ def main() -> None:
     # exact failure erased two rounds of benchmarks. After EVERY stage a
     # full parse-able JSON snapshot of everything measured so far goes to
     # stdout with "partial": true; the driver takes the LAST JSON line, so
-    # a timeout leaves the freshest snapshot as the artifact.
+    # a timeout leaves the freshest snapshot as the artifact. On top of
+    # that, every stage runs under a HARD per-stage deadline
+    # (DEEQU_TPU_BENCH_STAGE_BUDGET_S, run_stage_with_deadline): a stage
+    # that blows its budget is marked "skipped_deadline" in the "stages"
+    # map and the bench proceeds — no stage can starve the ones after it.
     out: dict = {}
     completed: list = []
+    stages: dict = {}
 
-    def checkpoint(stage: str) -> None:
-        completed.append(stage)
+    def checkpoint(stage: str, status: str = "ok") -> None:
+        stages[stage] = status
+        if status == "ok":
+            completed.append(stage)
         line = dict(out)
         line["partial"] = True
         line["completed_stages"] = list(completed)
+        line["stages"] = dict(stages)
         print(json.dumps(line), flush=True)
 
-    device = run_device_resident_stage()
-    out["device_scan_rows_per_sec"] = round(device["rows_per_sec"], 1)
-    out["device_scan_gbps"] = round(device["achieved_gbps"], 2)
-    checkpoint("device_scan")
+    def staged(name: str, fn, *args, **kwargs):
+        result, status, _seconds = run_stage_with_deadline(name, fn, *args, **kwargs)
+        if status != "ok":
+            checkpoint(name, status)
+        return result
 
-    device_profile = run_device_profile_stage()
-    out["device_profile_rows_per_sec"] = round(device_profile["rows_per_sec"], 1)
-    out["device_profile_rows"] = device_profile["rows"]
-    checkpoint("device_profile")
+    device = staged("device_scan", run_device_resident_stage)
+    if device is not None:
+        out["device_scan_rows_per_sec"] = round(device["rows_per_sec"], 1)
+        out["device_scan_gbps"] = round(device["achieved_gbps"], 2)
+        checkpoint("device_scan")
 
-    merge = run_device_merge_stage()
-    out["sketch_merge_gbps"] = round(merge["kll"], 3)
-    out["hll_merge_gbps"] = round(merge["hll"], 3)
-    checkpoint("device_merge")
+    device_profile = staged("device_profile", run_device_profile_stage)
+    if device_profile is not None:
+        out["device_profile_rows_per_sec"] = round(device_profile["rows_per_sec"], 1)
+        out["device_profile_rows"] = device_profile["rows"]
+        out["device_profile_compile_probe_s"] = round(
+            device_profile["compile_probe_seconds"], 1
+        )
+        checkpoint("device_profile")
+
+    merge = staged("device_merge", run_device_merge_stage)
+    if merge is not None:
+        out["sketch_merge_gbps"] = round(merge["kll"], 3)
+        out["hll_merge_gbps"] = round(merge["hll"], 3)
+        checkpoint("device_merge")
 
     # The bench host is SHARED: under heavy contention the host-tier stages
     # can run 10-50x slower than on a quiet box, and the BASELINE-shape row
@@ -898,37 +1000,48 @@ def main() -> None:
             profile_rows = effective
             scan_rows = min(scan_rows, max(10_000_000, profile_rows // 2))
 
-    scan = run_scan_stage(scan_rows, batch_size=1 << 20)
-    out["scan_rows_per_sec_per_chip"] = round(scan["rows_per_sec"], 1)
-    out["scan_vs_baseline"] = round(scan["vs_single_core"], 2)
-    checkpoint("scan")
+    scan = staged("scan", run_scan_stage, scan_rows, batch_size=1 << 20)
+    if scan is not None:
+        out["scan_rows_per_sec_per_chip"] = round(scan["rows_per_sec"], 1)
+        out["scan_vs_baseline"] = round(scan["vs_single_core"], 2)
+        checkpoint("scan")
 
-    profile = run_profile_stage(profile_rows)
-    out["metric"] = "column_profiler_rows_per_sec_per_chip"
-    out["value"] = round(profile["rows_per_sec"], 1)
-    out["unit"] = "rows/s"
-    out["vs_baseline"] = round(profile["vs_single_core"], 2)
-    out["vs_64core_linear"] = round(profile["vs_64core_linear"], 3)
-    checkpoint("profile")
+    profile = staged("profile", run_profile_stage, profile_rows)
+    if profile is not None:
+        out["metric"] = "column_profiler_rows_per_sec_per_chip"
+        out["value"] = round(profile["rows_per_sec"], 1)
+        out["unit"] = "rows/s"
+        out["vs_baseline"] = round(profile["vs_single_core"], 2)
+        out["vs_64core_linear"] = round(profile["vs_64core_linear"], 3)
+        checkpoint("profile")
 
-    incremental = run_incremental_stage(max(scan_rows // 2, 100_000), n_partitions=2)
-    out["state_merge_seconds"] = round(incremental["merge_seconds"], 3)
-    out["state_merge_bytes"] = incremental["state_bytes"]
-    checkpoint("incremental")
+    incremental = staged(
+        "incremental", run_incremental_stage,
+        max(scan_rows // 2, 100_000), n_partitions=2,
+    )
+    if incremental is not None:
+        out["state_merge_seconds"] = round(incremental["merge_seconds"], 3)
+        out["state_merge_bytes"] = incremental["state_bytes"]
+        checkpoint("incremental")
 
-    spill = run_spill_stage(max(scan_rows // 2, 100_000))
-    out["spill_rows_per_sec"] = round(spill["rows_per_sec"], 1)
-    checkpoint("spill")
+    spill = staged("spill", run_spill_stage, max(scan_rows // 2, 100_000))
+    if spill is not None:
+        out["spill_rows_per_sec"] = round(spill["rows_per_sec"], 1)
+        checkpoint("spill")
 
-    suggest = run_suggestion_stage(max(profile_rows // 20, 100_000))
-    out["suggest_seconds"] = round(suggest["seconds"], 2)
-    out["suggest_cold_seconds"] = round(suggest["cold_seconds"], 2)
-    out["suggestions"] = suggest["suggestions"]
-    checkpoint("suggest")
+    suggest = staged(
+        "suggest", run_suggestion_stage, max(profile_rows // 20, 100_000)
+    )
+    if suggest is not None:
+        out["suggest_seconds"] = round(suggest["seconds"], 2)
+        out["suggest_cold_seconds"] = round(suggest["cold_seconds"], 2)
+        out["suggestions"] = suggest["suggestions"]
+        checkpoint("suggest")
 
     final = dict(out)
     final["partial"] = False
     final["completed_stages"] = completed
+    final["stages"] = stages
     print(json.dumps(final), flush=True)
 
 

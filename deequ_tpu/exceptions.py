@@ -45,6 +45,39 @@ class EmptyStateException(MetricCalculationRuntimeException):
     """All input values were null/filtered — no state to finalize."""
 
 
+class DeviceFailureException(MetricCalculationRuntimeException):
+    """The accelerator tier failed for INFRASTRUCTURE reasons (XLA runtime
+    error, lost device, relay/tunnel fault) rather than anything about the
+    data or the analyzer. The reliability layer treats this class as
+    tier-recoverable: the same battery re-runs on the host ingest tier,
+    which shares no device state with the failed pass."""
+
+
+class DeviceOOMException(DeviceFailureException):
+    """The device ran out of memory executing a pass. Recoverable by batch
+    bisection (smaller padded batches shrink the live feature set) before
+    the general host-tier failover applies."""
+
+
+class PoisonedBatchException(MetricCalculationRuntimeException):
+    """A specific input batch cannot be processed (corrupt encoding,
+    malformed values past the dry-run validation). Carries the batch index
+    so operators can quarantine the slice."""
+
+    def __init__(self, batch_index: int, message: str = ""):
+        self.batch_index = batch_index
+        super().__init__(
+            f"batch {batch_index} is poisoned{': ' + message if message else ''}"
+        )
+
+
+class AnalyzerFaultException(MetricCalculationRuntimeException):
+    """A fault attributable to ONE analyzer inside a fused battery. The
+    isolation machinery bisects the battery until the faulty analyzer is
+    alone in its partition, degrades it to a typed Failure metric, and
+    completes the rest."""
+
+
 class UnsupportedFormatVersionError(Exception):
     """A persisted payload (metrics-history JSON or .npz state blob) carries
     a format version this build does not understand. Raised INSTEAD of

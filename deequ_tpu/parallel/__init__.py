@@ -31,7 +31,51 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..reliability.faults import fault_point
+
 ROW_AXIS = "rows"
+
+
+def _ensure_global(tree, mesh: Mesh, specs):
+    """Host arrays -> global jax.Arrays laid out per ``specs`` when the
+    mesh spans PROCESSES (jax.distributed): a multi-process jit cannot
+    auto-shard plain numpy inputs the way single-process jit does, so each
+    process contributes its addressable shards from its (identical) host
+    copy via ``make_array_from_callback``. Single-process: no-op — jit's
+    own in_shardings placement is cheaper. This is what turns the
+    module docstring's DCN claim into executable truth (exercised by
+    ``tools/dcn_smoke.py``)."""
+    if jax.process_count() == 1:
+        return tree
+
+    def convert(x, spec):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x  # already a global array (e.g. a prior fold's output)
+        arr = np.asarray(x)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(
+        convert, tree, specs,
+        is_leaf=lambda x: isinstance(x, (np.ndarray, jax.Array)),
+    )
+
+
+def _local_view(tree):
+    """Read back a replicated-per-device result in a multi-process run:
+    every device holds the identical value, so each process reads its OWN
+    first addressable shard (indexing a non-addressable global array would
+    throw). Single-process: identity."""
+    if jax.process_count() == 1:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x.addressable_data(0))
+        if isinstance(x, jax.Array) and not x.is_fully_addressable
+        else x,
+        tree,
+    )
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs):
@@ -171,6 +215,25 @@ def sharded_ingest_fold(
             # single-device _ingest_program — no per-chunk state copies
         )
         _SHARDED_INGEST_CACHE[key] = program
+    fault_point("sharded_fold")
+    if jax.process_count() > 1:
+        def spec_of_tree(tree):
+            # np.ndim reads rank from metadata — jnp.asarray here would
+            # device_put every (large) stacked leaf just to ask its rank
+            return jax.tree_util.tree_map(
+                lambda x: P(ROW_AXIS, *([None] * (np.ndim(x) - 1))), tree
+            )
+
+        states_stacked = _ensure_global(
+            states_stacked, mesh, spec_of_tree(states_stacked)
+        )
+        partials_stacked = _ensure_global(
+            partials_stacked, mesh, spec_of_tree(partials_stacked)
+        )
+        flags = _ensure_global(
+            np.asarray(flags), mesh, P(ROW_AXIS)
+        )
+        return program(states_stacked, partials_stacked, flags)
     return program(states_stacked, partials_stacked, np.asarray(flags))
 
 
@@ -294,8 +357,16 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
             )
         )
         _COLLECTIVE_MERGE_CACHE[cache_key] = program
+    fault_point("collective_merge")
+    if jax.process_count() > 1:
+        spec = jax.tree_util.tree_map(
+            lambda x: P(ROW_AXIS, *([None] * (np.ndim(x) - 1))), padded
+        )
+        padded = _ensure_global(padded, mesh, spec)
     merged = program(padded)
     # every device holds the identical full merge; take device 0's copy
+    # (each PROCESS reads its own addressable replica on a DCN mesh)
+    merged = _local_view(merged)
     return tuple(
         jax.tree_util.tree_map(lambda x: x[0], tree) for tree in merged
     )

@@ -1,0 +1,48 @@
+"""Fault tolerance for the verification engine.
+
+The reference inherits retry, speculative execution and partial-failure
+semantics from Spark; the jax/XLA engine gets none of that for free, and a
+production verification service cannot 500 a whole battery because one
+column's sketch overflowed. This package is the substrate:
+
+- :mod:`.faults` — deterministic, seeded fault injection at named engine
+  and service sites (``fault_point``), so every recovery path below is
+  exercised on demand instead of waiting for real hardware to misbehave;
+- :mod:`.isolation` — analyzer isolation by battery bisection (exactly the
+  faulty analyzers degrade to typed ``Failure`` metrics), device→host tier
+  failover for XLA/runtime errors, and OOM-triggered batch bisection;
+- :mod:`.checkpoint` — resumable multi-batch ingest: algebraic states
+  checkpoint through the existing ``StatePersister`` every K batches, and
+  an interrupted run resumes from the last checkpoint with results equal
+  to the uninterrupted run.
+
+See README "Failure semantics" for the operator-facing contract.
+"""
+
+from .checkpoint import IngestCheckpointer, ResumePoint, battery_fingerprint
+from .faults import (
+    FAULT_SEED_ENV,
+    FAULTS_ENV,
+    FaultInjector,
+    FaultSpec,
+    InjectedInterrupt,
+    WorkerCrash,
+    active_injector,
+    clear,
+    fault_point,
+    inject,
+    install,
+)
+from .isolation import (
+    ResilientScanOutcome,
+    classify_failure,
+    run_scan_resilient,
+)
+
+__all__ = [
+    "IngestCheckpointer", "ResumePoint", "battery_fingerprint",
+    "FaultSpec", "FaultInjector", "InjectedInterrupt", "WorkerCrash",
+    "inject", "install", "clear", "fault_point", "active_injector",
+    "FAULTS_ENV", "FAULT_SEED_ENV",
+    "ResilientScanOutcome", "classify_failure", "run_scan_resilient",
+]

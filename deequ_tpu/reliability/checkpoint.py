@@ -1,0 +1,266 @@
+"""Resumable ingest: periodic algebraic-state checkpoints through the
+existing :class:`StatePersister` machinery.
+
+A multi-batch fold is a left fold of commutative-semigroup states over the
+batch sequence, so the state after batch ``k`` plus the remaining batches
+``k+1..n`` determines the final state EXACTLY — the same algebraic property
+the reference exploits for incremental computation over growing data
+(`analyzers/StateProvider.scala:37-66`). The checkpointer persists every
+analyzer's state every ``every`` batches (scan-battery states AND host
+accumulator states such as grouping frequency tables), together with a
+meta record pinning the fold position and shape; an interrupted run then
+resumes from the last checkpoint and provably equals the uninterrupted
+run: the engine re-enters the batch loop at the checkpoint index with the
+restored states, and batch indices are preserved so index-keyed logic
+(the KLL sampler offsets) replays identically.
+
+The meta record validates before any resume: batch size, row count, and
+the battery fingerprint must match, else the checkpoint is ignored and the
+run starts fresh (a checkpoint from a DIFFERENT run shape must never leak
+states into this one). Completion clears the meta so a finished run's
+checkpoint cannot resurrect into the next.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
+
+_META_FILENAME = "ingest-checkpoint-meta.json"
+
+
+@dataclass(frozen=True)
+class _HostStateKey:
+    """Analyzer-shaped persistence key for host accumulator states whose
+    run-time key is not an Analyzer (the shared per-grouping-set frequency
+    tables key on ``("__grouping__", cols)``). Duck-types the two members
+    the providers read: ``name`` and a stable ``repr``."""
+
+    ident: str
+
+    @property
+    def name(self) -> str:
+        return "HostAccumulator"
+
+    def __repr__(self) -> str:
+        return f"HostAccumulator({self.ident})"
+
+
+def _host_key(key: Any) -> Any:
+    from ..analyzers.base import Analyzer
+
+    if isinstance(key, Analyzer):
+        return key
+    return _HostStateKey(str(key))
+
+
+def _snapshot_state(state: Any) -> Any:
+    """An immutable-for-our-purposes copy of a host accumulator state at
+    checkpoint time. Frequency tables copy their merged series (a spilled
+    table raises its usual budget error — it cannot be persisted anyway);
+    everything else deep-copies (host states are small numpy/pandas
+    structures)."""
+    from ..analyzers.grouping import FrequenciesAndNumRows
+
+    if isinstance(state, FrequenciesAndNumRows):
+        return FrequenciesAndNumRows(
+            state.frequencies.copy(), state.num_rows,
+            list(state.group_columns),
+        )
+    import copy
+
+    return copy.deepcopy(state)
+
+
+def battery_fingerprint(
+    scan_analyzers: Sequence[Any], host_keys: Sequence[Any]
+) -> str:
+    """Stable identity of what a run folds: analyzer reprs + host keys.
+    Hashed so the meta record stays small for wide batteries."""
+    import hashlib
+
+    payload = "\x1f".join(
+        [repr(a) for a in scan_analyzers] + [str(k) for k in sorted(
+            (str(k) for k in host_keys)
+        )]
+    )
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+@dataclass
+class ResumePoint:
+    """What a resumed run starts from. ``host_batch_index`` can run AHEAD
+    of ``batch_index``: the host tier folds accumulators per batch on the
+    submitting thread while scan states advance per chunk fold, so each
+    records its own high-water mark and the resumed run replays each from
+    its own position."""
+
+    batch_index: int
+    scan_states: List[Any]
+    host_states: Dict[Any, Any]
+    host_batch_index: int = 0
+
+
+class IngestCheckpointer:
+    """Checkpoint/resume driver around one StateLoader+StatePersister.
+
+    ``provider`` must be both a loader and a persister (the same contract
+    streaming sessions put on their state providers). Meta rides next to
+    the states: as a JSON file for directory-backed providers (anything
+    with a ``path``), else through the provider itself under a sentinel
+    key (the in-memory provider stores arbitrary objects).
+    """
+
+    def __init__(self, provider: Any, every: int = 8):
+        from ..analyzers.state_provider import StateLoader, StatePersister
+
+        if not (
+            isinstance(provider, StateLoader)
+            and isinstance(provider, StatePersister)
+        ):
+            raise TypeError(
+                "checkpoint provider must be both a StateLoader and a "
+                f"StatePersister, got {type(provider).__name__}"
+            )
+        if int(every) < 1:
+            raise ValueError(f"checkpoint interval must be >= 1, got {every}")
+        self.provider = provider
+        self.every = int(every)
+        #: observability: (batch_index, n_states) per save, newest last
+        self.saves: List[Tuple[int, int]] = []
+
+    # -- meta ----------------------------------------------------------------
+
+    def _meta_path(self) -> Optional[str]:
+        path = getattr(self.provider, "path", None)
+        if path is None:
+            return None
+        from .. import io as dio
+
+        return dio.join(path, _META_FILENAME)
+
+    _META_SENTINEL = _HostStateKey("__ingest_checkpoint_meta__")
+
+    def _write_meta(self, meta: Optional[Dict[str, Any]]) -> None:
+        path = self._meta_path()
+        if path is not None:
+            from .. import io as dio
+
+            if meta is None:
+                if dio.exists(path):
+                    dio.write_text_atomic(path, json.dumps({"cleared": True}))
+            else:
+                dio.write_text_atomic(path, json.dumps(meta))
+            return
+        self.provider.persist(self._META_SENTINEL, meta)
+
+    def _read_meta(self) -> Optional[Dict[str, Any]]:
+        path = self._meta_path()
+        if path is not None:
+            from .. import io as dio
+
+            if not dio.exists(path):
+                return None
+            with dio.open_file(path, "r") as fh:
+                meta = json.load(fh)
+            return None if meta.get("cleared") else meta
+        return self.provider.load(self._META_SENTINEL)
+
+    # -- checkpoint lifecycle ------------------------------------------------
+
+    def save(
+        self,
+        batch_index: int,
+        batch_size: int,
+        num_rows: int,
+        scan_analyzers: Sequence[Any],
+        scan_states: Sequence[Any],
+        host_states: Dict[Any, Any],
+        host_batch_index: Optional[int] = None,
+    ) -> None:
+        """Persist one checkpoint with an invalidate-first protocol: the
+        meta record is CLEARED, then every state overwrites its slot, then
+        the new meta lands. States share fixed per-analyzer keys, so a
+        crash mid-save would otherwise leave the PREVIOUS meta (batch K)
+        paired with a mix of batch-K and batch-K' states — a resume would
+        then silently double-fold batches K..K'. With the invalidation
+        marker, a torn save costs the resume point (the next run starts
+        from batch 0) but can never corrupt results."""
+        from .faults import fault_point
+
+        fault_point("checkpoint", tag=str(batch_index))
+        self._write_meta(None)  # invalidate: states are about to be torn
+        for analyzer, state in zip(scan_analyzers, scan_states):
+            self.provider.persist(analyzer, state)
+        for key, state in host_states.items():
+            # SNAPSHOT mutable accumulator states: the run keeps folding
+            # into the live object after this save, and an in-memory
+            # provider stores references — without the copy, the
+            # "checkpoint" would silently track the live state and a
+            # resume would double-fold every batch since the save
+            self.provider.persist(_host_key(key), _snapshot_state(state))
+        self._write_meta(
+            {
+                "batch_index": int(batch_index),
+                "batch_size": int(batch_size),
+                "num_rows": int(num_rows),
+                "host_batch_index": int(
+                    batch_index if host_batch_index is None else host_batch_index
+                ),
+                "fingerprint": battery_fingerprint(
+                    scan_analyzers, list(host_states)
+                ),
+            }
+        )
+        self.saves.append((int(batch_index), len(list(scan_analyzers))))
+
+    def load(
+        self,
+        batch_size: int,
+        num_rows: int,
+        scan_analyzers: Sequence[Any],
+        host_keys: Sequence[Any],
+    ) -> Optional[ResumePoint]:
+        """The resume point for a run of this exact shape, or None (no
+        checkpoint / shape mismatch / any state missing)."""
+        meta = self._read_meta()
+        if not meta:
+            return None
+        fingerprint = battery_fingerprint(scan_analyzers, host_keys)
+        if (
+            int(meta.get("batch_size", -1)) != int(batch_size)
+            or int(meta.get("num_rows", -1)) != int(num_rows)
+            or meta.get("fingerprint") != fingerprint
+        ):
+            _logger.info(
+                "ingest checkpoint ignored: run shape changed "
+                "(meta=%s, now batch_size=%d num_rows=%d fp=%s)",
+                meta, batch_size, num_rows, fingerprint,
+            )
+            return None
+        scan_states = [self.provider.load(a) for a in scan_analyzers]
+        if any(s is None for s in scan_states):
+            return None
+        host_states = {}
+        for key in host_keys:
+            state = self.provider.load(_host_key(key))
+            if state is None:
+                return None
+            # snapshot on the way OUT too: the resumed run folds into this
+            # object, and an in-memory provider must keep holding the
+            # checkpoint-time value until the next save overwrites it
+            host_states[key] = _snapshot_state(state)
+        batch_index = int(meta["batch_index"])
+        return ResumePoint(
+            batch_index, scan_states, host_states,
+            host_batch_index=int(meta.get("host_batch_index", batch_index)),
+        )
+
+    def complete(self) -> None:
+        """Mark the run finished: clears the meta so the NEXT run over this
+        provider starts fresh instead of resuming a done fold."""
+        self._write_meta(None)

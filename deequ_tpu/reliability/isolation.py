@@ -1,0 +1,308 @@
+"""Analyzer isolation and tier failover for the fused scan.
+
+The fused ``PackedScanProgram`` buys its 38x scan-sharing speedup by making
+every analyzer ride ONE XLA program — which also makes every failure a
+battery-wide failure: the reference degrades per-analyzer because Spark
+aggregates are independent expressions (`AnalysisRunner.scala:320-323`),
+but one bad value, one device fault or one blown compile here used to kill
+all N analyzers' metrics at once. This module restores the reference's
+contract on top of the fused engine:
+
+- **Tier ladder** (:func:`_attempt_tiered`): a device-infrastructure
+  failure (XLA runtime error, lost device) re-runs the SAME battery on the
+  host ingest tier — fresh states, no device residue; an OOM first bisects
+  the batch size (smaller padded batches shrink the live feature set)
+  before falling back. Every hop is recorded on the RunMonitor so the
+  service's placement router learns to keep the battery off the sick tier.
+- **Battery bisection** (:func:`run_scan_resilient`): a failure that
+  survives the tier ladder is attributed by bisecting the analyzer battery
+  and re-running partitions — log2(N) extra passes in the worst case —
+  until exactly the faulty analyzers are alone in their partitions and
+  degrade to typed ``Failure`` metrics while everyone else completes.
+- **Host-accumulator knockout**: host-side accumulators (grouping
+  frequency tables, histogram fallbacks) fold OUTSIDE the fused program,
+  so they need no bisection — each update fn is guarded, and the first
+  error knocks only that accumulator out for the rest of the pass.
+
+Interrupts (``KeyboardInterrupt`` and other non-``Exception``
+``BaseException``s) deliberately pass through every layer here: an
+operator ^C or a preemption must abort the run, not degrade it — the
+resumable-ingest checkpoints are the recovery story for those.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
+
+#: batch-size floor below which OOM bisection gives up (padding dominates)
+_MIN_BISECT_BATCH = 1 << 10
+
+#: OOM bisections per attempt before the tier ladder falls through to host
+_MAX_OOM_BISECTIONS = 3
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``"oom"`` | ``"device"`` | ``"data"`` — what recovery applies.
+
+    Typed exceptions from our own taxonomy classify directly; raw
+    jax/jaxlib runtime errors (which carry no type hierarchy worth
+    matching on) classify by the XLA status phrases they embed. Anything
+    else is a data/analyzer-level failure: re-running it elsewhere would
+    fail the same way, so only bisection helps."""
+    from ..exceptions import DeviceFailureException, DeviceOOMException
+
+    if isinstance(exc, DeviceOOMException):
+        return "oom"
+    if isinstance(exc, DeviceFailureException):
+        return "device"
+    message = str(exc)
+    if (
+        "RESOURCE_EXHAUSTED" in message
+        or "Out of memory" in message
+        or "out of memory" in message.lower()
+    ):
+        return "oom"
+    if type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError") or any(
+        marker in message
+        for marker in ("INTERNAL:", "UNAVAILABLE:", "DATA_LOSS:", "ABORTED:")
+    ):
+        return "device"
+    return "data"
+
+
+@dataclass
+class ResilientScanOutcome:
+    """Per-analyzer results of a resilient scan: disjoint success states
+    and typed errors, plus the host accumulator states/errors."""
+
+    states: Dict[Any, Any] = field(default_factory=dict)
+    errors: Dict[Any, BaseException] = field(default_factory=dict)
+    host_states: Dict[Any, Any] = field(default_factory=dict)
+    host_errors: Dict[Any, BaseException] = field(default_factory=dict)
+
+
+def _guard_host_updates(
+    host_updates: Dict[Any, Callable],
+    host_errors: Dict[Any, BaseException],
+    monitor,
+) -> Dict[Any, Callable]:
+    """Wrap each accumulator's update fn so one raising accumulator is
+    knocked out (typed Failure later) without touching the others or the
+    device battery."""
+
+    def make(key, fn):
+        def guarded(state, batch):
+            if key in host_errors:
+                return state
+            try:
+                return fn(state, batch)
+            except Exception as exc:  # noqa: BLE001 - degrade only this key
+                host_errors[key] = exc
+                monitor.note_degraded(f"host:{key}")
+                _logger.warning(
+                    "host accumulator %s knocked out: %s", key, exc
+                )
+                return state
+
+        return guarded
+
+    return {key: make(key, fn) for key, fn in host_updates.items()}
+
+
+def run_scan_resilient(
+    run_pass: Callable,
+    battery: Sequence[Any],
+    make_host_states: Callable[[], Tuple[Dict[Any, Any], Dict[Any, Callable]]],
+    monitor,
+    *,
+    batch_size: int,
+    placement: Optional[str],
+) -> ResilientScanOutcome:
+    """Run the shared pass with isolation + failover.
+
+    ``run_pass(analyzers, host_states, host_updates, placement, batch_size)
+    -> (states, host_states)`` executes one engine pass (the runner owns
+    engine construction); ``make_host_states() -> (states, update_fns)``
+    builds FRESH host accumulators — retries must never refold into
+    partially-updated state.
+    """
+    outcome = ResilientScanOutcome()
+    host_keys = list(make_host_states()[0])
+    progress = {"host_done": not host_keys, "bisecting": False}
+
+    def attempt(part: Tuple, with_host: bool):
+        if with_host:
+            host_states, host_updates = make_host_states()
+            # keep already-knocked-out keys dead across retries: their
+            # first error is the typed result, and refolding a partially
+            # poisoned accumulator would just re-raise
+            host_updates = _guard_host_updates(
+                host_updates, outcome.host_errors, monitor
+            )
+        else:
+            host_states, host_updates = {}, {}
+        states, folded = _attempt_tiered(
+            run_pass, part, host_states, host_updates,
+            monitor, batch_size=batch_size, placement=placement,
+        )
+        return states, folded
+
+    def degrade(part: Tuple, exc: BaseException) -> None:
+        for analyzer in part:
+            outcome.errors[analyzer] = exc
+            monitor.note_degraded(repr(analyzer))
+
+    def run_partition(part: Tuple):
+        """Run one partition, bisecting on failure. Returns (fully_failed,
+        signature): fully_failed means EVERY member degraded, and
+        ``signature`` identifies the failure when they all failed alike."""
+        try:
+            states, folded = attempt(part, with_host=not progress["host_done"])
+        except Exception as exc:  # noqa: BLE001 - typed degradation below
+            signature = (type(exc), str(exc))
+            if len(part) <= 1:
+                if part:
+                    degrade(part, exc)
+                    _logger.warning(
+                        "analyzer %r isolated as faulty: %s", part[0], exc
+                    )
+                else:
+                    # a host-only pass failed outright: every accumulator
+                    # that hasn't already got a more specific error shares
+                    # the pass failure
+                    for key in host_keys:
+                        outcome.host_errors.setdefault(key, exc)
+                    progress["host_done"] = True
+                return True, signature
+            if not progress["bisecting"]:
+                progress["bisecting"] = True
+                _logger.warning(
+                    "fused battery of %d analyzers failed (%s: %s); "
+                    "bisecting to isolate", len(part), type(exc).__name__, exc,
+                )
+            monitor.isolation_reruns += 1
+            mid = len(part) // 2
+            left, right = part[:mid], part[mid:]
+            failed_left, sig_left = run_partition(left)
+            if failed_left and len(left) > 1 and sig_left == signature:
+                # the left subtree (more than one member) reproduced the
+                # parent failure WHOLESALE: this is a pass-level fault
+                # (corrupt input, dead tier) no bisection can isolate —
+                # bisecting the right half would burn ~2x its size in
+                # identical full-data re-passes. A single faulty analyzer
+                # never trips this: its clean siblings succeed, so no >1
+                # subtree fully fails.
+                _logger.warning(
+                    "partition of %d reproduced the same failure wholesale; "
+                    "degrading the remaining %d analyzers without further "
+                    "re-passes", len(left), len(right),
+                )
+                degrade(right, exc)
+                return True, signature
+            failed_right, sig_right = run_partition(right)
+            return (
+                failed_left and failed_right
+                and sig_left == sig_right == signature,
+                signature,
+            )
+        for analyzer, state in zip(part, states):
+            outcome.states[analyzer] = state
+        if not progress["host_done"]:
+            outcome.host_states = folded
+            progress["host_done"] = True
+        return False, None
+
+    battery = tuple(battery)
+    if battery or host_keys:
+        run_partition(battery)
+    if not progress["host_done"]:
+        # every battery partition failed before any pass completed with the
+        # accumulators attached — give them one dedicated battery-free pass
+        try:
+            _, folded = attempt((), with_host=True)
+            outcome.host_states = folded
+        except Exception as exc:  # noqa: BLE001
+            for key in host_keys:
+                outcome.host_errors.setdefault(key, exc)
+    # a knocked-out accumulator's folded state is partial garbage: drop it
+    for key in outcome.host_errors:
+        outcome.host_states.pop(key, None)
+    return outcome
+
+
+def _attempt_tiered(
+    run_pass: Callable,
+    part: Tuple,
+    host_states: Dict[Any, Any],
+    host_updates: Dict[Any, Callable],
+    monitor,
+    *,
+    batch_size: int,
+    placement: Optional[str],
+):
+    """One partition through the tier ladder: device (as placed) with OOM
+    batch bisection, then host-tier failover for device-infrastructure
+    failures when every member supports host partials."""
+    bs = batch_size
+    placement_now = placement
+    oom_left = _MAX_OOM_BISECTIONS
+    host_capable = bool(part) and all(
+        getattr(a, "supports_host_partial", False) for a in part
+    )
+    while True:
+        try:
+            return run_pass(
+                part, dict(host_states), host_updates,
+                placement=placement_now, batch_size=bs,
+            )
+        except Exception as exc:  # noqa: BLE001 - ladder decides
+            kind = classify_failure(exc)
+            if (
+                kind == "oom"
+                and oom_left > 0
+                and bs // 2 >= _MIN_BISECT_BATCH
+                and placement_now != "host"
+            ):
+                oom_left -= 1
+                bs //= 2
+                monitor.batch_bisections += 1
+                _logger.warning(
+                    "device OOM (%s); bisecting batch size to %d", exc, bs
+                )
+                # host accumulators refold from scratch on the retry: the
+                # failed pass left them partially updated
+                host_states = _refresh_host_states(host_states, monitor)
+                continue
+            if kind in ("oom", "device") and placement_now != "host" and host_capable:
+                monitor.device_failovers += 1
+                monitor.note_degraded(f"tier:device->{kind}")
+                _logger.warning(
+                    "device tier failed (%s: %s); failing battery of %d "
+                    "over to the host ingest tier",
+                    type(exc).__name__, exc, len(part),
+                )
+                placement_now = "host"
+                host_states = _refresh_host_states(host_states, monitor)
+                continue
+            raise
+
+
+def _refresh_host_states(host_states: Dict[Any, Any], monitor) -> Dict[Any, Any]:
+    """Fresh identity states for the accumulators a failed pass partially
+    updated (same keys; grouping tables re-empty, host_init re-runs)."""
+    from ..analyzers.base import Analyzer
+    from ..analyzers.grouping import FrequenciesAndNumRows
+
+    fresh: Dict[Any, Any] = {}
+    for key, state in host_states.items():
+        if isinstance(state, FrequenciesAndNumRows):
+            fresh[key] = FrequenciesAndNumRows.empty(list(state.group_columns))
+        elif isinstance(key, Analyzer) and hasattr(key, "host_init"):
+            fresh[key] = key.host_init()
+        else:
+            fresh[key] = state
+    return fresh

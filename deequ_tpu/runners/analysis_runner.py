@@ -69,6 +69,7 @@ class AnalysisRunner:
         monitor: Optional[RunMonitor] = None,
         sharding: Optional[Any] = None,
         placement: Optional[str] = None,
+        checkpointer: Optional[Any] = None,
     ) -> AnalyzerContext:
         if len(analyzers) == 0:
             return AnalyzerContext.empty()
@@ -192,69 +193,128 @@ class AnalysisRunner:
                 host_accum.append(a)
         device_hist = [a for a in device_hist if a in device_hist_set]
 
-        # one shared pass over the data
+        # one shared pass over the data — executed through the reliability
+        # layer: a device-infrastructure failure fails the battery over to
+        # the host tier (OOMs first bisect the batch size), and an
+        # analyzer-level fault bisects the battery until exactly the faulty
+        # analyzers degrade to typed Failure metrics while the rest
+        # complete (the fused-engine restoration of the reference's
+        # per-expression degradation, `AnalysisRunner.scala:320-323`)
         scan_battery = scanning + list(device_freq.values())
-        engine = ScanEngine(scan_battery, monitor=monitor, sharding=sharding, placement=placement)
+        run_monitor = monitor or RunMonitor()
 
-        host_states: Dict[Any, Any] = {}
-        host_updates: Dict[Any, Any] = {}
-        for cols in grouping_sets:
-            if cols in device_freq:
-                continue
-            key = ("__grouping__", cols)
-            host_states[key] = FrequenciesAndNumRows.empty(list(cols))
-            host_updates[key] = lambda st, batch: st.update(batch)
-        for a in host_accum:
-            host_states[a] = a.host_init()
-            host_updates[a] = a.host_update
+        def make_host_states():
+            hs: Dict[Any, Any] = {}
+            hu: Dict[Any, Any] = {}
+            for cols in grouping_sets:
+                if cols in device_freq:
+                    continue
+                key = ("__grouping__", cols)
+                hs[key] = FrequenciesAndNumRows.empty(list(cols))
+                hu[key] = lambda st, batch: st.update(batch)
+            for a in host_accum:
+                hs[a] = a.host_init()
+                hu[a] = a.host_update
+            return hs, hu
 
-        need_pass = bool(scan_battery) or bool(host_states)
+        host_keys = list(make_host_states()[0])
+        need_pass = bool(scan_battery) or bool(host_keys)
         metrics: Dict[Analyzer, Metric] = {}
         if need_pass:
-            try:
-                columns = _columns_needed(engine, grouping_sets, host_accum, schema)
-                device_states, host_states = engine.run(
-                    data,
-                    batch_size=batch_size,
-                    host_accumulators=host_states,
-                    host_update_fns=host_updates,
-                    columns=columns,
-                )
-            except Exception as exc:  # noqa: BLE001
-                # pass-level failure: every analyzer in the shared scan gets a
-                # failure metric (reference `AnalysisRunner.scala:320-323`)
-                for a in scanning + grouping + host_accum + device_hist:
-                    metrics[a] = a.to_failure_metric(exc)
-            else:
-                # scanning analyzers: load old state -> merge -> persist -> metric
-                # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
-                for a, state in zip(scanning, device_states):
-                    metrics[a] = _finalize(a, state, aggregate_with, save_states_with)
-                device_freq_states = dict(
-                    zip(device_freq, device_states[len(scanning):])
-                )
-                for cols, members in grouping_sets.items():
-                    if cols in device_freq:
-                        scan = device_freq[cols]
-                        shared = scan.to_frequencies(
-                            device_freq_states[cols], device_dicts[cols]
-                        )
-                    else:
-                        shared = host_states[("__grouping__", cols)]
-                    for a in members:
-                        metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
-                for a in host_accum:
-                    metrics[a] = _finalize(a, host_states[a], aggregate_with, save_states_with)
-                from ..analyzers.grouping import device_counts_to_histogram_frequencies
+            from ..reliability import run_scan_resilient
+            from .engine import effective_batch_size
 
-                for a in device_hist:
-                    cols = (a.column,)
-                    shared = device_counts_to_histogram_frequencies(
-                        device_freq[cols],
-                        device_freq_states[cols],
-                        device_dicts[cols],
+            full_battery = tuple(scan_battery)
+
+            def run_pass(part, hs, hu, *, placement=None, batch_size=None):
+                engine = ScanEngine(
+                    list(part), monitor=run_monitor, sharding=sharding,
+                    placement=placement,
+                )
+                g_sets = [
+                    key[1] for key in hs
+                    if isinstance(key, tuple) and key and key[0] == "__grouping__"
+                ]
+                h_accum = [key for key in hs if not isinstance(key, tuple)]
+                cols = _columns_needed(engine, g_sets, h_accum, schema)
+                # checkpoints belong to the primary full-battery fold only:
+                # bisection re-passes must not clobber its resume point
+                ckpt = checkpointer if tuple(part) == full_battery else None
+                return engine.run(
+                    data, batch_size=batch_size, host_accumulators=hs,
+                    host_update_fns=hu, columns=cols, checkpointer=ckpt,
+                )
+
+            outcome = run_scan_resilient(
+                run_pass, full_battery, make_host_states, run_monitor,
+                batch_size=effective_batch_size(data, batch_size),
+                placement=placement,
+            )
+
+            # scanning analyzers: load old state -> merge -> persist -> metric
+            # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
+            for a in scanning:
+                if a in outcome.states:
+                    metrics[a] = _finalize(
+                        a, outcome.states[a], aggregate_with, save_states_with
                     )
-                    metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
+                else:
+                    metrics[a] = a.to_failure_metric(outcome.errors[a])
+            device_freq_states = {
+                cols: outcome.states.get(scan)
+                for cols, scan in device_freq.items()
+            }
+
+            def shared_frequencies(cols):
+                """The grouping state for ``cols``, or the typed error that
+                took its producer down (device scan or host accumulator)."""
+                if cols in device_freq:
+                    scan = device_freq[cols]
+                    if device_freq_states[cols] is None:
+                        return None, outcome.errors[scan]
+                    return (
+                        scan.to_frequencies(
+                            device_freq_states[cols], device_dicts[cols]
+                        ),
+                        None,
+                    )
+                key = ("__grouping__", cols)
+                if key in outcome.host_errors:
+                    return None, outcome.host_errors[key]
+                return outcome.host_states[key], None
+
+            for cols, members in grouping_sets.items():
+                shared, err = shared_frequencies(cols)
+                for a in members:
+                    if err is not None:
+                        metrics[a] = a.to_failure_metric(err)
+                    else:
+                        metrics[a] = _finalize(
+                            a, shared, aggregate_with, save_states_with
+                        )
+            for a in host_accum:
+                if a in outcome.host_errors:
+                    metrics[a] = a.to_failure_metric(outcome.host_errors[a])
+                else:
+                    metrics[a] = _finalize(
+                        a, outcome.host_states[a], aggregate_with,
+                        save_states_with,
+                    )
+            from ..analyzers.grouping import device_counts_to_histogram_frequencies
+
+            for a in device_hist:
+                cols = (a.column,)
+                if device_freq_states[cols] is None:
+                    metrics[a] = a.to_failure_metric(
+                        outcome.errors[device_freq[cols]]
+                    )
+                    continue
+                shared = device_counts_to_histogram_frequencies(
+                    device_freq[cols],
+                    device_freq_states[cols],
+                    device_dicts[cols],
+                )
+                metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
         for a in others:
             metrics[a] = a.to_failure_metric(
                 MetricCalculationException(f"No execution strategy for analyzer {a}")

@@ -28,6 +28,7 @@ from ..analyzers.base import ScanShareableAnalyzer
 from ..analyzers.grouping import FrequenciesAndNumRows, GroupingAnalyzer
 from ..config import DEFAULT_BATCH_SIZE
 from ..data import Dataset
+from ..reliability.faults import fault_point
 from .features import FeatureBuilder
 
 _logger = logging.getLogger(__name__)
@@ -39,7 +40,15 @@ class RunMonitor:
     which ingest tier a run executed on (``placement``), the probed feed
     bandwidth that drove the decision, and per-phase wall time
     (``phase_seconds``) so a run's cost is attributable without external
-    tooling (SURVEY §5: lightweight phase timers)."""
+    tooling (SURVEY §5: lightweight phase timers).
+
+    The reliability fields are the engine-side ledger the service's
+    placement router learns from: ``device_failovers`` counts device→host
+    tier hops, ``batch_bisections`` OOM-driven batch halvings,
+    ``isolation_reruns`` battery-bisection re-passes, and ``degraded``
+    names what was knocked out (analyzer reprs, host accumulator keys,
+    tier hops). ``checkpoint_saves``/``resumed_at_batch`` trace the
+    resumable-ingest path."""
 
     passes: int = 0
     batches: int = 0
@@ -48,6 +57,12 @@ class RunMonitor:
     placement: Optional[str] = None
     feed_bandwidth_mbps: Optional[float] = None
     phase_seconds: Dict[str, float] = field(default_factory=dict)
+    device_failovers: int = 0
+    batch_bisections: int = 0
+    isolation_reruns: int = 0
+    degraded: List[str] = field(default_factory=list)
+    checkpoint_saves: int = 0
+    resumed_at_batch: Optional[int] = None
 
     def reset(self) -> None:
         self.passes = 0
@@ -57,6 +72,16 @@ class RunMonitor:
         self.placement = None
         self.feed_bandwidth_mbps = None
         self.phase_seconds = {}
+        self.device_failovers = 0
+        self.batch_bisections = 0
+        self.isolation_reruns = 0
+        self.degraded = []
+        self.checkpoint_saves = 0
+        self.resumed_at_batch = None
+
+    def note_degraded(self, tag: str) -> None:
+        with _MONITOR_LOCK:
+            self.degraded.append(tag)
 
     def add_phase_time(self, phase: str, seconds: float) -> None:
         with _MONITOR_LOCK:
@@ -231,6 +256,13 @@ class PackedScanProgram:
         """Packed carry -> ordinary per-analyzer state pytrees (on device)."""
         return self._unpack_jit(carry)
 
+    def pack_states(self, states: Tuple):
+        """Ordinary per-analyzer state pytrees -> packed carry; the inverse
+        of :meth:`unpack`, used to re-enter the fused loop from
+        checkpointed (host numpy) states. Lossless: every scalar leaf's
+        dtype is ACC_DTYPE/COUNT_DTYPE, the packed vectors' own dtypes."""
+        return self._pack(tuple(states))
+
     def _cache_size(self) -> int:
         return self._update._cache_size()
 
@@ -247,6 +279,7 @@ def _fused_program(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
     with _PROGRAM_CACHE_LOCK:
         cached = _PROGRAM_CACHE.get(key)
         if cached is None:
+            fault_point("compile", tag=str(len(analyzers)))
             cached = PackedScanProgram(analyzers, mesh)
             _PROGRAM_CACHE[key] = cached
         return cached
@@ -492,6 +525,7 @@ def _fetch_states_packed(states: Tuple) -> List[Any]:
     every level row above the deepest occupied one."""
     from ..ops.kll import KLLSketchState
 
+    fault_point("state_fetch")
     kll_idx = [
         i for i, s in enumerate(states)
         if isinstance(s, KLLSketchState)
@@ -798,6 +832,11 @@ class _DeviceFeatureCache:
             self._admission_stop_logged = False
 
 
+#: env var overriding the host ingest tier's partial-worker pool size
+#: (default: all cores). The `tools/host_tier_sweep.py` scaling sweep
+#: drives this; PERF.md records the measured workers -> rows/s curve.
+HOST_TIER_WORKERS_ENV = "DEEQU_TPU_HOST_TIER_WORKERS"
+
 #: env var enabling the device feature cache; value = HBM budget in GB
 DEVICE_FEATURE_CACHE_ENV = "DEEQU_TPU_DEVICE_FEATURE_CACHE"
 _DEVICE_FEATURE_CACHE: Optional[_DeviceFeatureCache] = None
@@ -1028,6 +1067,7 @@ class ScanEngine:
         (numpy / pyarrow / the native C++ kernels all release the GIL)."""
         with self.monitor.timed("feature_build"):
             features = self.builder.build(batch)
+        fault_point("device_feed")
         with self.monitor.timed("device_feed"):
             if self.mesh is not None:
                 from ..parallel import shard_features
@@ -1046,9 +1086,17 @@ class ScanEngine:
         host_accumulators: Optional[Dict[Any, Any]] = None,
         host_update_fns: Optional[Dict[Any, Any]] = None,
         columns: Optional[Sequence[str]] = None,
+        checkpointer: Optional[Any] = None,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Run the shared pass. Returns (device states per scan analyzer,
         host accumulator states keyed as given).
+
+        ``checkpointer`` (a `reliability.IngestCheckpointer`) makes the
+        multi-batch fold resumable: algebraic states persist every
+        ``checkpointer.every`` batches, and a run over the same data shape
+        restarts from the last checkpoint instead of batch 0 — the states
+        fold identically (same batch boundaries, same batch indices), so
+        the resumed result equals the uninterrupted one.
 
         Set ``DEEQU_TPU_PROFILE_DIR`` to capture a ``jax.profiler`` trace of
         every pass into that directory (SURVEY §5's optional profiler hook;
@@ -1066,7 +1114,8 @@ class ScanEngine:
             tracer = contextlib.nullcontext()
         with tracer:
             return self._run_inner(
-                data, batch_size, host_accumulators, host_update_fns, columns
+                data, batch_size, host_accumulators, host_update_fns, columns,
+                checkpointer,
             )
 
     def _run_inner(
@@ -1076,6 +1125,7 @@ class ScanEngine:
         host_accumulators: Optional[Dict[Any, Any]] = None,
         host_update_fns: Optional[Dict[Any, Any]] = None,
         columns: Optional[Sequence[str]] = None,
+        checkpointer: Optional[Any] = None,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         monitor = self.monitor
         monitor.passes += 1
@@ -1088,8 +1138,35 @@ class ScanEngine:
         has_battery = bool(self.scan_analyzers)
         if not has_battery and not host_states:
             return [], {}
+        for a in self.scan_analyzers:
+            # one probe per analyzer per pass: the injection point through
+            # which tests pin "exactly the faulty analyzer degrades"
+            fault_point("analyzer", tag=repr(a))
+        ckpt = checkpointer
+        if ckpt is not None and self.mesh is not None:
+            _logger.warning(
+                "ingest checkpointing is not supported on a mesh; "
+                "running without checkpoints"
+            )
+            ckpt = None
+        resume = None
+        if ckpt is not None:
+            resume = ckpt.load(
+                bs, int(data.num_rows), list(self.scan_analyzers),
+                list(host_states),
+            )
+            if resume is not None:
+                monitor.resumed_at_batch = resume.batch_index
+                host_states.update(resume.host_states)
+                _logger.info(
+                    "resuming ingest from checkpoint at batch %d",
+                    resume.batch_index,
+                )
         if has_battery and self._resolve_placement() == "host":
-            return self._run_host_tier(data, bs, host_states, update_fns, columns)
+            return self._run_host_tier(
+                data, bs, host_states, update_fns, columns,
+                checkpointer=ckpt, resume=resume,
+            )
         if has_battery and self._update is None:
             # constructed under a host resolution but asked to run device
             # (defensive: resolution is deterministic per process)
@@ -1138,6 +1215,31 @@ class ScanEngine:
             return batch, self._prepare(batch)
 
         carry = self._update.init_carry() if self._update is not None else None
+        folded = 0
+        if resume is not None:
+            # re-enter the fold at the checkpoint: restore the carry from
+            # the persisted states and skip the already-folded batches
+            # (index alignment preserved, so feature-cache keys and any
+            # index-keyed analyzer logic see the same numbering)
+            folded = resume.batch_index
+            if self._update is not None:
+                carry = self._update.pack_states(tuple(resume.scan_states))
+            for _ in range(folded):
+                next(idx_counter)
+                next(batches)
+
+        def save_checkpoint():
+            with monitor.timed("checkpoint"):
+                if carry is not None:
+                    ck_states = _fetch_states_packed(self._update.unpack(carry))
+                else:
+                    ck_states = []
+                ckpt.save(
+                    folded, bs, int(data.num_rows),
+                    list(self.scan_analyzers), ck_states, host_states,
+                )
+                monitor.checkpoint_saves += 1
+
         with ThreadPoolExecutor(max_workers=1) as pool:
             pending = pool.submit(produce)
             while True:
@@ -1148,12 +1250,18 @@ class ScanEngine:
                 batch, features = item
                 monitor.batches += 1
                 if features is not None:
+                    fault_point("device_update", tag=str(folded + 1))
                     with monitor.timed("device_dispatch"):
                         carry = self._update(carry, features)
                     monitor.device_updates += 1
                 with monitor.timed("host_accumulators"):
                     for key, fn in update_fns.items():
                         host_states[key] = fn(host_states[key], batch)
+                folded += 1
+                if ckpt is not None and folded % ckpt.every == 0:
+                    save_checkpoint()
+        if ckpt is not None:
+            ckpt.complete()
         if carry is not None:
             states = self._update.unpack(carry)
         if cache_size_fn is not None:
@@ -1166,7 +1274,8 @@ class ScanEngine:
         return host_side, host_states
 
     def _run_host_tier(
-        self, data, bs, host_states, update_fns, columns
+        self, data, bs, host_states, update_fns, columns,
+        checkpointer: Optional[Any] = None, resume: Optional[Any] = None,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Host ingest tier: per-batch partial states next to the data, then
         chunked device folds of the stacked partials (+ one packed state
@@ -1217,6 +1326,14 @@ class ScanEngine:
                 for j in range(n_real_b):
                     states_list[b[j]] = sub[j]
             states = tuple(states_list)
+        start_batch = 0
+        host_start = 0
+        if resume is not None and mesh is None:
+            start_batch = resume.batch_index
+            # accumulators fold per SUBMITTED batch (ahead of the chunked
+            # scan states), so they resume from their own high-water mark
+            host_start = resume.host_batch_index
+            states = tuple(resume.scan_states)
 
         # one token per pass: host partials may skip work a previous batch
         # of the SAME pass already contributed (e.g. HLL registers of
@@ -1224,11 +1341,13 @@ class ScanEngine:
         run_token = object()
 
         def compute_partial(index: int, batch) -> Tuple:
+            fault_point("host_partial", tag=str(index))
             with monitor.timed("host_partials"):
                 ctx = HostBatchContext(batch, batch_index=index, run_token=run_token)
                 return tuple(a.host_partial(ctx) for a in analyzers)
 
         def fold_chunk(states, group: List[Tuple], n_real: int):
+            fault_point("ingest_fold")
             with monitor.timed("ingest_fold"):
                 stacked = tuple(
                     jax.tree_util.tree_map(
@@ -1261,29 +1380,65 @@ class ScanEngine:
 
         from collections import deque
 
-        workers = max(2, os.cpu_count() or 1)
+        workers = 0
+        workers_env = os.environ.get(HOST_TIER_WORKERS_ENV)
+        if workers_env:
+            try:
+                workers = max(1, int(workers_env))
+            except ValueError:
+                # a typo'd sweep var must not crash every host-tier pass
+                # (which the resilience layer would then bisect N times)
+                _logger.warning(
+                    "ignoring invalid %s=%r; using the core-count default",
+                    HOST_TIER_WORKERS_ENV, workers_env,
+                )
+        workers = workers or max(2, os.cpu_count() or 1)
         window = workers + chunk  # in-flight bound: O(window) live batches
         pending: deque = deque()
         buffer: List[Tuple] = []
-        n = 0
+        n = start_batch
+        #: folded = batches merged into `states`; saved = last checkpoint.
+        #: Host-tier checkpoints land on chunk boundaries (states only
+        #: advance per chunk fold), so a resume point is always chunk-
+        #: aligned and the resumed fold replays identically.
+        progress = {"folded": start_batch, "saved": start_batch}
+
+        def maybe_checkpoint(states):
+            if checkpointer is None or mesh is not None:
+                return
+            if progress["folded"] - progress["saved"] < checkpointer.every:
+                return
+            with monitor.timed("checkpoint"):
+                checkpointer.save(
+                    progress["folded"], bs, int(data.num_rows),
+                    list(analyzers), _fetch_states_packed(tuple(states)),
+                    host_states, host_batch_index=n,
+                )
+                monitor.checkpoint_saves += 1
+            progress["saved"] = progress["folded"]
 
         def drain_one(states):
             buffer.append(pending.popleft().result())
             if len(buffer) == chunk:
                 states = fold_chunk(states, list(buffer), n_real=chunk)
                 buffer.clear()
+                progress["folded"] += chunk
+                maybe_checkpoint(states)
             return states
 
         with ThreadPoolExecutor(max_workers=workers) as pool:
             for index, batch in enumerate(
                 data.batches(bs, columns=columns, pad_to_batch_size=False)
             ):
+                if index < start_batch:
+                    continue  # already folded into the resumed states
                 monitor.batches += 1
                 n += 1
                 pending.append(pool.submit(compute_partial, index, batch))
-                with monitor.timed("host_accumulators"):
-                    for key, fn in update_fns.items():
-                        host_states[key] = fn(host_states[key], batch)
+                if index >= host_start:
+                    with monitor.timed("host_accumulators"):
+                        for key, fn in update_fns.items():
+                            host_states[key] = fn(host_states[key], batch)
                 # backpressure: never let un-drained batches outgrow the
                 # window, so peak memory stays O(window), not O(dataset)
                 while len(pending) > window:
@@ -1316,6 +1471,8 @@ class ScanEngine:
             from ..parallel import collective_merge_states
 
             states = collective_merge_states(analyzers, mesh, states)
+        if checkpointer is not None and mesh is None:
+            checkpointer.complete()
         with monitor.timed("state_fetch"):
             host_side = _fetch_states_packed(states)
         return host_side, host_states

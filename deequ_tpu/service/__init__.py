@@ -116,6 +116,7 @@ class VerificationService:
         priority: Priority = Priority.NORMAL,
         deadline_s: Optional[float] = None,
         max_retries: int = 2,
+        retry_on: Tuple[type, ...] = (),
         batch_size: Optional[int] = None,
         metrics_repository: Optional[Any] = None,
         save_or_append_results_with_key: Optional[Any] = None,
@@ -165,6 +166,7 @@ class VerificationService:
             priority=priority,
             deadline_s=deadline_s,
             max_retries=max_retries,
+            retry_on=retry_on,
             signature=signature,
             warm_fn=warm,
         )

@@ -118,6 +118,14 @@ class PlacementRouter:
         #: signatures with a warm currently IN FLIGHT (dedup only — every
         #: terminal path discards, so a cold battery can always re-warm)
         self._warming: Set[Signature] = set()
+        #: signature -> remaining host-tier probation runs after a
+        #: device-tier failure on that battery (engine failover evidence
+        #: harvested from RunMonitor). While positive, decide() answers
+        #: "host" outright: the battery keeps completing next to the data
+        #: instead of re-hitting a sick device; the countdown then
+        #: re-admits it to the device tier, so a transient fault does not
+        #: exile a battery forever. Bounded like every long-lived map here.
+        self._device_suspect = BoundedLRU(256)
         self._warmer: Optional[ThreadPoolExecutor] = (
             ThreadPoolExecutor(max_workers=1, thread_name_prefix="deequ-warmer")
             if background_warm
@@ -141,6 +149,17 @@ class PlacementRouter:
             "deequ_service_warm_failures_total",
             "Background warms that raised; the battery stays on the host "
             "tier (see the service log for the exception).",
+        )
+        self.metrics.describe(
+            "deequ_service_device_failures_total",
+            "Jobs whose engine run recorded a device-tier failure "
+            "(failover or OOM bisection); the battery enters host-tier "
+            "probation.",
+        )
+        self.metrics.describe(
+            "deequ_service_suspect_host_routes_total",
+            "Placement decisions answered 'host' because the battery was "
+            "on device-failure probation.",
         )
 
     def is_warm(self, signature: Signature) -> bool:
@@ -177,6 +196,15 @@ class PlacementRouter:
         rate."""
         if not signature:
             return None
+        with self._lock:
+            probation = self._device_suspect.get(signature)
+            if probation:
+                # the battery recently took a device-tier fault: serve it
+                # from the host tier for the rest of its probation, then
+                # let it try the device again
+                self._device_suspect[signature] = probation - 1
+                self.metrics.inc("deequ_service_suspect_host_routes_total")
+                return "host"
         if self.is_warm(signature):  # .get inside refreshes LRU recency
             self.metrics.inc("deequ_service_placement_cache_hits_total")
             return None
@@ -236,6 +264,23 @@ class PlacementRouter:
             # worker that asked for a placement
             with self._lock:
                 self._warming.discard(signature)
+
+    #: decisions a battery spends on the host tier after a device failure
+    #: before it may try the device again
+    SUSPECT_PROBATION_RUNS = 8
+
+    def note_device_failure(self, signature: Signature) -> None:
+        """The engine recorded a device-tier failure (failover to host /
+        OOM bisection) running this battery — the scheduler harvests this
+        from the job's RunMonitor. Routes the battery to the host tier for
+        the next :data:`SUSPECT_PROBATION_RUNS` decisions and drops its
+        warmth claim: whatever program was resident is now suspect."""
+        if not signature:
+            return
+        with self._lock:
+            self._device_suspect[signature] = self.SUSPECT_PROBATION_RUNS
+        self._ran.pop(signature, None)
+        self.metrics.inc("deequ_service_device_failures_total")
 
     # -- worker affinity -----------------------------------------------------
 

@@ -179,6 +179,15 @@ class JobScheduler:
             "deequ_service_job_retries_total",
             "Transient-failure retries that were re-enqueued with backoff.",
         )
+        self.metrics.describe(
+            "deequ_service_isolation_reruns_total",
+            "Battery-bisection re-passes run to isolate faulty analyzers.",
+        )
+        self.metrics.describe(
+            "deequ_service_degraded_analyzers_total",
+            "Analyzers/accumulators degraded to typed Failure metrics "
+            "instead of failing their whole run.",
+        )
         self.metrics.set_gauge_fn(
             "deequ_service_queue_depth", self.pending,
             "Jobs admitted but not yet running.",
@@ -377,6 +386,11 @@ class JobScheduler:
             placement=self.router.decide(job.signature, job.warm_fn),
         )
         try:
+            from ..reliability.faults import fault_point
+
+            # chaos site: a WorkerCrash here simulates the worker dying
+            # mid-job (executor loss); the job must still terminate typed
+            fault_point("worker", tag=str(worker_id))
             value = job.fn(ctx)
         except BaseException as exc:  # noqa: BLE001 - routed into the taxonomy
             self._harvest(job, ctx)
@@ -421,6 +435,23 @@ class JobScheduler:
         for phase, seconds in ctx.monitor.phase_seconds.items():
             job.handle.phase_seconds[phase] = (
                 job.handle.phase_seconds.get(phase, 0.0) + seconds
+            )
+        monitor = ctx.monitor
+        if monitor.device_failovers or monitor.batch_bisections:
+            # the engine survived a device-tier fault under this battery:
+            # teach the router to keep the battery on the host tier for a
+            # probation window (also fires on failed attempts, so a retry
+            # lands on the healthy tier immediately)
+            self.router.note_device_failure(job.signature)
+        if monitor.isolation_reruns:
+            self.metrics.inc(
+                "deequ_service_isolation_reruns_total",
+                float(monitor.isolation_reruns), tenant=job.tenant,
+            )
+        if monitor.degraded:
+            self.metrics.inc(
+                "deequ_service_degraded_analyzers_total",
+                float(len(monitor.degraded)), tenant=job.tenant,
             )
 
     def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:

@@ -195,6 +195,11 @@ class StreamingSession:
             # re-folding would merge the batch into the persisted states a
             # second time; hand back the memoized committed result
             return self._notify(done)
+        from ..reliability.faults import fault_point
+
+        # chaos site: fails a fold BEFORE any state mutates, so retry
+        # semantics stay exercisable without double-count hazards
+        fault_point("stream_fold", tag=ctx.job_id)
         with self._serial:
             if self._closed:
                 raise SessionClosed(self.tenant, self.dataset)

@@ -47,6 +47,10 @@ class BoundedLRU:
         with self._lock:
             return len(self._data)
 
+    def pop(self, key, default=None):
+        with self._lock:
+            return self._data.pop(key, default)
+
     def keys(self):
         with self._lock:
             return list(self._data.keys())

@@ -100,6 +100,7 @@ class VerificationSuite:
         monitor: Optional[Any] = None,
         sharding: Optional[Any] = None,
         placement: Optional[str] = None,
+        checkpointer: Optional[Any] = None,
     ) -> VerificationResult:
         from .runners.analysis_runner import collect_required_analyzers
 
@@ -122,6 +123,7 @@ class VerificationSuite:
             monitor=monitor,
             sharding=sharding,
             placement=placement,
+            checkpointer=checkpointer,
         )
         result = VerificationSuite.evaluate(checks, analysis_results)
         if metrics_repository is not None and save_or_append_results_with_key is not None:
@@ -201,6 +203,7 @@ class VerificationRunBuilder:
         self._monitor = None
         self._sharding = None
         self._placement: Optional[str] = None
+        self._checkpointer = None
         self._check_results_path: Optional[str] = None
         self._success_metrics_path: Optional[str] = None
 
@@ -246,6 +249,16 @@ class VerificationRunBuilder:
         self._placement = placement
         return self
 
+    def checkpoint_with(self, checkpointer) -> "VerificationRunBuilder":
+        """Make the multi-batch ingest resumable: a
+        `reliability.IngestCheckpointer` persists algebraic states every K
+        batches through its StatePersister, and an interrupted run invoked
+        again with the same checkpointer resumes from the last checkpoint
+        with metrics equal to the uninterrupted run (see README "Failure
+        semantics")."""
+        self._checkpointer = checkpointer
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -272,6 +285,7 @@ class VerificationRunBuilder:
             monitor=self._monitor,
             sharding=self._sharding,
             placement=self._placement,
+            checkpointer=self._checkpointer,
         )
         # URI-aware sinks (reference writes these through Hadoop FileSystem,
         # `VerificationSuite.scala:146-172` / `io/DfsUtils.scala:24-85`)

@@ -1,0 +1,174 @@
+"""Port of the reference's `AnalysisBasedConstraintTest.scala` mocked-metric
+scenarios (VERDICT r5 ask #6): constraint evaluation against a hand-built
+metric map — no data pass — pinning the failure-message contract and the
+status precedence rules of `constraints/AnalysisBasedConstraint.scala:42-122`.
+
+Scenarios (reference test names in comments):
+- assert correctly on values if analysis is successful
+- missing analysis -> MISSING_ANALYSIS_MESSAGE, never an exception
+- value picker runs on the metric value; a RAISING picker degrades to
+  PROBLEMATIC_METRIC_PICKER
+- a raising assertion degrades to ASSERTION_EXCEPTION
+- a Failure metric propagates its exception message
+- check/suite status precedence: constraint failures roll up by check
+  level (Error > Warning > Success)
+"""
+
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Mean, Size
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.constraints import (
+    ASSERTION_EXCEPTION,
+    MISSING_ANALYSIS_MESSAGE,
+    PROBLEMATIC_METRIC_PICKER,
+    AnalysisBasedConstraint,
+    ConstraintStatus,
+)
+from deequ_tpu.exceptions import MetricCalculationRuntimeException
+from deequ_tpu.metrics import DoubleMetric, Entity, Failure, Success
+from deequ_tpu.runners.context import AnalyzerContext
+from deequ_tpu.verification import VerificationSuite
+
+
+def _metric(value, analyzer=None, success=True):
+    analyzer = analyzer or Completeness("att1")
+    wrapped = (
+        Success(float(value))
+        if success
+        else Failure(MetricCalculationRuntimeException(str(value)))
+    )
+    return DoubleMetric(Entity.COLUMN, analyzer.name, analyzer.instance, wrapped)
+
+
+class TestAnalysisBasedConstraintScenarios:
+    def test_assert_correctly_on_values_if_analysis_is_successful(self):
+        # reference: "assert correctly on values if analysis is successful"
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric(0.5, analyzer)}
+        passing = AnalysisBasedConstraint(analyzer, lambda v: v == 0.5)
+        failing = AnalysisBasedConstraint(analyzer, lambda v: v > 0.9)
+        assert passing.evaluate(results).status == ConstraintStatus.SUCCESS
+        failed = failing.evaluate(results)
+        assert failed.status == ConstraintStatus.FAILURE
+        assert "does not meet the constraint requirement" in failed.message
+
+    def test_missing_analysis_yields_typed_message(self):
+        # reference: evaluation without the metric in the context
+        constraint = AnalysisBasedConstraint(
+            Completeness("att1"), lambda v: v == 1.0
+        )
+        result = constraint.evaluate({})
+        assert result.status == ConstraintStatus.FAILURE
+        assert result.message == MISSING_ANALYSIS_MESSAGE
+
+    def test_value_picker_runs_on_metric_value(self):
+        # reference: "execute value picker on the analysis result value"
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric(0.5, analyzer)}
+        constraint = AnalysisBasedConstraint(
+            analyzer, lambda v: v == 50, value_picker=lambda v: v * 100
+        )
+        assert constraint.evaluate(results).status == ConstraintStatus.SUCCESS
+
+    def test_failing_value_picker_degrades_typed(self):
+        # reference: "fail on analysis if value picker is provided but fails"
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric(0.5, analyzer)}
+
+        def exploding_picker(value):
+            raise RuntimeError("picker exploded")
+
+        constraint = AnalysisBasedConstraint(
+            analyzer, lambda v: True, value_picker=exploding_picker
+        )
+        result = constraint.evaluate(results)
+        assert result.status == ConstraintStatus.FAILURE
+        assert result.message.startswith(PROBLEMATIC_METRIC_PICKER)
+        assert result.metric is not None  # the metric itself was fine
+
+    def test_raising_assertion_degrades_typed(self):
+        # reference: "fail on failed assertion" (exception variant)
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric(0.5, analyzer)}
+
+        def exploding_assertion(value):
+            raise ValueError("assertion exploded")
+
+        constraint = AnalysisBasedConstraint(analyzer, exploding_assertion)
+        result = constraint.evaluate(results)
+        assert result.status == ConstraintStatus.FAILURE
+        assert result.message.startswith(ASSERTION_EXCEPTION)
+
+    def test_failure_metric_propagates_exception_message(self):
+        # reference: a failed metric calculation surfaces in the constraint
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric("division by zero", analyzer, success=False)}
+        constraint = AnalysisBasedConstraint(analyzer, lambda v: True)
+        result = constraint.evaluate(results)
+        assert result.status == ConstraintStatus.FAILURE
+        assert "division by zero" in result.message
+
+    def test_hint_rides_the_failure_message(self):
+        analyzer = Completeness("att1")
+        results = {analyzer: _metric(0.5, analyzer)}
+        constraint = AnalysisBasedConstraint(
+            analyzer, lambda v: v > 0.9, hint="att1 must be nearly complete"
+        )
+        result = constraint.evaluate(results)
+        assert "att1 must be nearly complete" in result.message
+
+
+class TestStatusPrecedence:
+    """Reference status-precedence behavior: constraint failures roll up to
+    their check's level, and the suite reports the MOST severe check."""
+
+    def _context(self, size_value: float) -> AnalyzerContext:
+        return AnalyzerContext(
+            {
+                Size(): DoubleMetric(
+                    Entity.DATASET, "Size", "*", Success(size_value)
+                ),
+                Mean("att1"): DoubleMetric(
+                    Entity.COLUMN, "Mean", "att1", Success(5.0)
+                ),
+            }
+        )
+
+    def test_error_check_failure_is_error(self):
+        check = Check(CheckLevel.ERROR, "errors").has_size(lambda n: n > 100)
+        result = check.evaluate(self._context(5))
+        assert result.status == CheckStatus.ERROR
+
+    def test_warning_check_failure_is_warning(self):
+        check = Check(CheckLevel.WARNING, "warns").has_size(lambda n: n > 100)
+        result = check.evaluate(self._context(5))
+        assert result.status == CheckStatus.WARNING
+
+    def test_suite_status_is_max_severity(self):
+        warning = Check(CheckLevel.WARNING, "warns").has_size(lambda n: n > 100)
+        error = Check(CheckLevel.ERROR, "errors").has_mean(
+            "att1", lambda m: m < 0
+        )
+        passing = Check(CheckLevel.ERROR, "passes").has_size(lambda n: n == 5)
+        context = self._context(5)
+        only_warning = VerificationSuite.evaluate([warning, passing], context)
+        assert only_warning.status == CheckStatus.WARNING
+        with_error = VerificationSuite.evaluate(
+            [warning, error, passing], context
+        )
+        assert with_error.status == CheckStatus.ERROR
+        all_pass = VerificationSuite.evaluate([passing], context)
+        assert all_pass.status == CheckStatus.SUCCESS
+
+    def test_success_inside_failing_check_stays_visible(self):
+        check = (
+            Check(CheckLevel.ERROR, "mixed")
+            .has_size(lambda n: n == 5)
+            .has_mean("att1", lambda m: m < 0)
+        )
+        result = check.evaluate(self._context(5))
+        statuses = [r.status for r in result.constraint_results]
+        assert ConstraintStatus.SUCCESS in statuses
+        assert ConstraintStatus.FAILURE in statuses
+        assert result.status == CheckStatus.ERROR

@@ -1,0 +1,5 @@
+"""Operator tooling: benchmarks, scaling sweeps, chaos drills, DCN smoke.
+
+Each module is a one-shot ``python -m tools.<name>`` entry point; see the
+module docstrings for what they measure and emit.
+"""

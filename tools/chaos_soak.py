@@ -1,0 +1,188 @@
+"""Chaos soak: drive the full verification service under injected faults.
+
+One-shot drill (``python -m tools.chaos_soak``) that arms the seeded fault
+injector (`deequ_tpu.reliability.faults`) with a mixed plan — device
+failures, OOMs, per-analyzer faults, worker deaths, streaming-fold crashes
+— then pushes a burst of one-shot verification jobs plus a streaming
+session through the `VerificationService` scheduler and asserts the
+reliability invariants:
+
+1. every job TERMINATES: a result or a typed ``ServiceError``, never a
+   hung handle;
+2. every completed verification carries a verdict for every analyzer —
+   injected analyzer faults degrade to typed ``Failure`` metrics, they do
+   not shrink the metric map;
+3. device faults never kill a run: the engine fails over to the host tier
+   (RunMonitor records it, the placement router learns);
+4. the streaming session's fold count equals its successful ingests (no
+   double-folds from retries, no silent drops).
+
+Exit code 0 iff all invariants hold; a JSON summary goes to stdout. The
+same ``run_soak`` body backs ``tests/test_chaos_soak.py`` (tier-1 runs a
+small soak; the big one is marked slow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+
+def _build_data(rows: int, seed: int):
+    import numpy as np
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(seed)
+    return Dataset.from_dict(
+        {
+            "x": rng.normal(size=rows),
+            "y": rng.normal(10.0, 2.0, size=rows),
+            "cat": [f"c{i % 13}" for i in range(rows)],
+        }
+    )
+
+
+def _checks():
+    from deequ_tpu.checks import Check, CheckLevel
+
+    return [
+        Check(CheckLevel.ERROR, "chaos battery")
+        .has_size(lambda n: n > 0)
+        .is_complete("x")
+        .has_mean("y", lambda m: 5.0 < m < 15.0)
+        .has_standard_deviation("y", lambda s: s > 0)
+        .has_approx_count_distinct("cat", lambda c: c > 0),
+    ]
+
+
+def default_plan(seed: int):
+    """The mixed fault plan: every major site, seeded probabilities, plus
+    one deterministic per-analyzer fault so isolation is always hit."""
+    from deequ_tpu.reliability import FaultSpec
+
+    return [
+        FaultSpec("device_update", "device", p=0.10, count=None),
+        FaultSpec("device_update", "oom", p=0.04, count=None),
+        FaultSpec("host_partial", "poison", p=0.01, count=3),
+        FaultSpec("analyzer", "analyzer", match="StandardDeviation", p=0.25,
+                  count=None),
+        FaultSpec("worker", "worker_death", p=0.08, count=None),
+        FaultSpec("stream_fold", "worker_death", p=0.10, count=None),
+        FaultSpec("compile", "stall", p=0.2, count=2, delay_s=0.05),
+    ]
+
+
+def run_soak(
+    jobs: int = 30,
+    stream_batches: int = 8,
+    rows: int = 4096,
+    seed: int = 0,
+    workers: int = 4,
+    specs=None,
+) -> Dict:
+    """Run the soak; returns the summary dict (see module docstring for
+    the invariants it asserts)."""
+    from deequ_tpu.reliability import WorkerCrash, install, clear
+    from deequ_tpu.runners.analysis_runner import collect_required_analyzers
+    from deequ_tpu.service import ServiceError, VerificationService
+
+    checks = _checks()
+    n_analyzers = len(dict.fromkeys(collect_required_analyzers(checks)))
+    data = _build_data(rows, seed)
+    injector = install(specs if specs is not None else default_plan(seed),
+                       seed=seed)
+    t0 = time.perf_counter()
+    summary: Dict = {
+        "jobs": jobs, "stream_batches": stream_batches, "seed": seed,
+        "succeeded": 0, "typed_failures": 0, "untyped_failures": 0,
+        "unterminated": 0, "incomplete_metric_maps": 0,
+        "degraded_metrics": 0, "stream_folds_ok": 0,
+    }
+    try:
+        with VerificationService(
+            workers=workers, max_queue_depth=jobs + stream_batches + 8,
+            background_warm=False,
+        ) as service:
+            handles = [
+                service.submit_verification(
+                    data, checks, tenant=f"t{i % 3}",
+                    max_retries=2, retry_on=(WorkerCrash,),
+                )
+                for i in range(jobs)
+            ]
+            session = service.session(
+                "chaos", "stream", checks, max_retries=0
+            )
+            stream_results = []
+            for b in range(stream_batches):
+                batch = _build_data(512, seed + 1000 + b)
+                try:
+                    stream_results.append(session.ingest(batch, timeout=120))
+                except ServiceError:
+                    stream_results.append(None)
+            for handle in handles:
+                try:
+                    result = handle.result(timeout=180)
+                except ServiceError:
+                    summary["typed_failures"] += 1
+                    continue
+                except TimeoutError:
+                    summary["unterminated"] += 1
+                    continue
+                except Exception:  # noqa: BLE001 - invariant breach
+                    summary["untyped_failures"] += 1
+                    continue
+                summary["succeeded"] += 1
+                if len(result.metrics) != n_analyzers:
+                    summary["incomplete_metric_maps"] += 1
+                summary["degraded_metrics"] += sum(
+                    1 for m in result.metrics.values() if m.value.is_failure
+                )
+            summary["stream_folds_ok"] = sum(
+                1 for r in stream_results if r is not None
+            )
+            # no silent drops/double folds: the session folded exactly the
+            # ingests that returned a result
+            summary["stream_fold_parity"] = (
+                session.batches_ingested == summary["stream_folds_ok"]
+            )
+            summary["faults_fired"] = len(injector.fired)
+            snapshot = service.json_snapshot()["counters"]
+            summary["device_failures_learned"] = snapshot.get(
+                "deequ_service_device_failures_total", 0
+            )
+    finally:
+        clear()
+    summary["seconds"] = round(time.perf_counter() - t0, 2)
+    summary["ok"] = (
+        summary["unterminated"] == 0
+        and summary["untyped_failures"] == 0
+        and summary["incomplete_metric_maps"] == 0
+        and summary["stream_fold_parity"]
+        and summary["succeeded"] + summary["typed_failures"] == jobs
+    )
+    return summary
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--jobs", type=int, default=30)
+    parser.add_argument("--stream-batches", type=int, default=8)
+    parser.add_argument("--rows", type=int, default=4096)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--workers", type=int, default=4)
+    args = parser.parse_args(argv)
+    summary = run_soak(
+        jobs=args.jobs, stream_batches=args.stream_batches, rows=args.rows,
+        seed=args.seed, workers=args.workers,
+    )
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

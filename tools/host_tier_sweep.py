@@ -1,0 +1,129 @@
+"""Host-tier worker scaling sweep (VERDICT r5 ask #7).
+
+Measures the host ingest tier's partial-computation throughput as a
+function of its thread-pool size: the config-2-shaped scan battery
+(moments + completeness + HLL + 2 KLL sketches over 4 numeric columns)
+runs with ``DEEQU_TPU_HOST_TIER_WORKERS`` forced to each sweep point, so
+the pool size is driven by measurement instead of ``os.cpu_count()``
+faith. Emits a human table on stderr and one JSON line on stdout;
+PERF.md's "Host-tier worker scaling" table records a run of this tool.
+
+Run: ``python -m tools.host_tier_sweep [rows] [--workers 1,2,4,8]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def battery():
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        KLLParameters,
+        KLLSketch,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+        Sum,
+    )
+
+    analyzers = []
+    for i in range(4):
+        column = f"x{i}"
+        analyzers += [
+            Completeness(column), Mean(column), Sum(column),
+            Minimum(column), Maximum(column), StandardDeviation(column),
+        ]
+    analyzers.append(ApproxCountDistinct("cat"))
+    analyzers += [
+        KLLSketch("x0", KLLParameters(2048, 0.64, 100)),
+        KLLSketch("x1", KLLParameters(2048, 0.64, 100)),
+    ]
+    return analyzers
+
+
+def build_data(rows: int):
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(42)
+    cols = {}
+    for i in range(4):
+        values = rng.normal(100 * i, 10, rows)
+        cols[f"x{i}"] = pa.array(values, mask=rng.random(rows) < 0.05)
+    cols["cat"] = pa.array(rng.integers(0, 100_000, rows))
+    return Dataset.from_arrow(pa.table(cols))
+
+
+def sweep(rows: int, workers_list, batch_size: int = 1 << 18) -> dict:
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.engine import HOST_TIER_WORKERS_ENV, RunMonitor
+
+    data = build_data(rows)
+    analyzers = battery()
+    results = {}
+    prior = os.environ.get(HOST_TIER_WORKERS_ENV)
+    try:
+        for workers in workers_list:
+            os.environ[HOST_TIER_WORKERS_ENV] = str(workers)
+            # warm pass compiles the ingest-fold programs so the timed run
+            # measures partial-computation scaling, not XLA compile
+            AnalysisRunner.do_analysis_run(
+                data, analyzers, batch_size=batch_size, placement="host"
+            )
+            monitor = RunMonitor()
+            t0 = time.perf_counter()
+            AnalysisRunner.do_analysis_run(
+                data, analyzers, batch_size=batch_size, placement="host",
+                monitor=monitor,
+            )
+            elapsed = time.perf_counter() - t0
+            phases = {
+                k: round(v, 2) for k, v in sorted(monitor.phase_seconds.items())
+            }
+            results[workers] = {
+                "seconds": round(elapsed, 2),
+                "rows_per_sec": round(rows / elapsed, 1),
+                "phases": phases,
+            }
+            print(
+                f"[sweep] workers={workers}: {elapsed:.2f}s "
+                f"({rows / elapsed / 1e6:.2f}M rows/s) phases={phases}",
+                file=sys.stderr, flush=True,
+            )
+    finally:
+        if prior is None:
+            os.environ.pop(HOST_TIER_WORKERS_ENV, None)
+        else:
+            os.environ[HOST_TIER_WORKERS_ENV] = prior
+    base = results[workers_list[0]]["rows_per_sec"]
+    for workers, row in results.items():
+        row["speedup_vs_first"] = round(row["rows_per_sec"] / base, 2)
+    return {
+        "rows": rows, "batch_size": batch_size,
+        "analyzers": len(analyzers), "sweep": results,
+    }
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    workers_list = [1, 2, 4, 8]
+    if "--workers" in argv:
+        i = argv.index("--workers")
+        workers_list = [int(w) for w in argv[i + 1].split(",")]
+        del argv[i:i + 2]
+    rows = int(argv[0]) if argv else 4_000_000
+    out = sweep(rows, workers_list)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
