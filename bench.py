@@ -29,6 +29,21 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def monitor_phase_fields(mon) -> dict:
+    """The per-stage observability fields the partial JSON records for every
+    monitored stage (VERDICT r5 ask #1b): NEW program compiles this run
+    (``compiles`` — a compile regression shows as a nonzero value on a warm
+    stage), plus the state_fetch vs device_dispatch phase split the r6
+    acceptance gate reads."""
+    return {
+        "compiles": mon.program_compiles,
+        "state_fetch_s": round(mon.phase_seconds.get("state_fetch", 0.0), 3),
+        "device_dispatch_s": round(
+            mon.phase_seconds.get("device_dispatch", 0.0), 3
+        ),
+    }
+
+
 # ---------------------------------------------------------------------------
 # per-stage hard deadlines (VERDICT r5 weak #1: the driver's wall-clock kill
 # must never erase completed stages' numbers — each stage now gets its own
@@ -149,6 +164,7 @@ def run_scan_stage(rows: int, batch_size: int) -> dict:
     )
     elapsed = time.perf_counter() - t0
     assert mon.passes == 1
+    scan_phases = monitor_phase_fields(mon)
     tpu_vals = {}
     for a, m in ctx.metric_map.items():
         if m.value.is_success and a.name in ("Completeness", "Mean", "Sum"):
@@ -182,7 +198,11 @@ def run_scan_stage(rows: int, batch_size: int) -> dict:
         f"-> {rate/(rows/base_s):.1f}x"
     )
     log(f"[scan] placement={mon.placement} phases: {phases}")
-    return {"rows_per_sec": rate, "vs_single_core": rate / (rows / base_s)}
+    return {
+        "rows_per_sec": rate,
+        "vs_single_core": rate / (rows / base_s),
+        **scan_phases,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -368,6 +388,7 @@ def run_profile_stage(rows: int) -> dict:
         "rows_per_sec": rate,
         "vs_single_core": vs_single,
         "vs_64core_linear": vs_single / 64,
+        **monitor_phase_fields(mon),
     }
 
 
@@ -549,11 +570,13 @@ def run_device_profile_stage(target_rows: int | None = None) -> dict:
     prior = os.environ.get("DEEQU_TPU_DEVICE_FEATURE_CACHE")
     os.environ["DEEQU_TPU_DEVICE_FEATURE_CACHE"] = "8"
     try:
+        stage_mon = RunMonitor()
         t0 = time.perf_counter()
         runner = (
             ColumnProfilerRunner.on_data(data)
             .with_placement("device")
             .with_batch_size(1 << 20)
+            .with_monitor(stage_mon)
         )
         profiles = runner.run()  # stages features into HBM + compiles
         stage_s = time.perf_counter() - t0
@@ -600,18 +623,28 @@ def run_device_profile_stage(target_rows: int | None = None) -> dict:
 
     rate = rows / elapsed
     phases = ", ".join(f"{k}={v:.2f}s" for k, v in sorted(mon.phase_seconds.items()))
+    fetch_s = mon.phase_seconds.get("state_fetch", 0.0)
+    dispatch_s = mon.phase_seconds.get("device_dispatch", 0.0)
     log(
         f"[device-profile] {rows:,} rows x 16 cols, placement=device, warm "
         f"feature cache: {elapsed:.2f}s -> {rate/1e6:.1f}M rows/s/chip "
-        f"(passes={mon.passes}; staging+compile run took {stage_s:.1f}s; "
-        f"metrics parity-checked vs numpy/arrow oracles)"
+        f"(passes={mon.passes}; staging+compile run took {stage_s:.1f}s, "
+        f"{stage_mon.program_compiles} staging compiles; metrics "
+        f"parity-checked vs numpy/arrow oracles)"
     )
     log(f"[device-profile] phases: {phases}")
+    log(
+        f"[device-profile] warm state_fetch={fetch_s:.2f}s vs "
+        f"device_dispatch={dispatch_s:.2f}s -> "
+        f"{'fetch-bound' if fetch_s > dispatch_s else 'dispatch-bound'}"
+    )
     return {
         "rows_per_sec": rate,
         "rows": rows,
         "stage_seconds": stage_s,
         "compile_probe_seconds": compile_probe_s,
+        "staging_compiles": stage_mon.program_compiles,
+        **monitor_phase_fields(mon),
     }
 
 
@@ -931,8 +964,14 @@ def main() -> None:
     completed: list = []
     stages: dict = {}
 
-    def checkpoint(stage: str, status: str = "ok") -> None:
-        stages[stage] = status
+    def checkpoint(stage: str, status: str = "ok", extra: dict | None = None) -> None:
+        # each stage's entry carries its status plus the compile/fetch
+        # observability fields (compiles, state_fetch_s, device_dispatch_s)
+        # so a compile or fetch regression is parseable from the artifact
+        entry = {"status": status}
+        if extra:
+            entry.update(extra)
+        stages[stage] = entry
         if status == "ok":
             completed.append(stage)
         line = dict(out)
@@ -947,12 +986,15 @@ def main() -> None:
             checkpoint(name, status)
         return result
 
-    device = staged("device_scan", run_device_resident_stage)
-    if device is not None:
-        out["device_scan_rows_per_sec"] = round(device["rows_per_sec"], 1)
-        out["device_scan_gbps"] = round(device["achieved_gbps"], 2)
-        checkpoint("device_scan")
+    def phase_extra(result: dict) -> dict:
+        keys = ("compiles", "state_fetch_s", "device_dispatch_s",
+                "staging_compiles")
+        return {k: result[k] for k in keys if k in result}
 
+    # NORTH-STAR-FIRST stage order (VERDICT r5 ask #1b): the device-placed
+    # profile and the config-3 profile produce the numbers the project is
+    # judged on, so they run before the synthetic device stages — a late
+    # wall-clock kill costs synthetic numbers, never the headline ones.
     device_profile = staged("device_profile", run_device_profile_stage)
     if device_profile is not None:
         out["device_profile_rows_per_sec"] = round(device_profile["rows_per_sec"], 1)
@@ -960,13 +1002,10 @@ def main() -> None:
         out["device_profile_compile_probe_s"] = round(
             device_profile["compile_probe_seconds"], 1
         )
-        checkpoint("device_profile")
-
-    merge = staged("device_merge", run_device_merge_stage)
-    if merge is not None:
-        out["sketch_merge_gbps"] = round(merge["kll"], 3)
-        out["hll_merge_gbps"] = round(merge["hll"], 3)
-        checkpoint("device_merge")
+        out["device_profile_staging_s"] = round(device_profile["stage_seconds"], 2)
+        out["device_profile_state_fetch_s"] = device_profile["state_fetch_s"]
+        out["device_profile_device_dispatch_s"] = device_profile["device_dispatch_s"]
+        checkpoint("device_profile", extra=phase_extra(device_profile))
 
     # The bench host is SHARED: under heavy contention the host-tier stages
     # can run 10-50x slower than on a quiet box, and the BASELINE-shape row
@@ -1000,12 +1039,6 @@ def main() -> None:
             profile_rows = effective
             scan_rows = min(scan_rows, max(10_000_000, profile_rows // 2))
 
-    scan = staged("scan", run_scan_stage, scan_rows, batch_size=1 << 20)
-    if scan is not None:
-        out["scan_rows_per_sec_per_chip"] = round(scan["rows_per_sec"], 1)
-        out["scan_vs_baseline"] = round(scan["vs_single_core"], 2)
-        checkpoint("scan")
-
     profile = staged("profile", run_profile_stage, profile_rows)
     if profile is not None:
         out["metric"] = "column_profiler_rows_per_sec_per_chip"
@@ -1013,7 +1046,25 @@ def main() -> None:
         out["unit"] = "rows/s"
         out["vs_baseline"] = round(profile["vs_single_core"], 2)
         out["vs_64core_linear"] = round(profile["vs_64core_linear"], 3)
-        checkpoint("profile")
+        checkpoint("profile", extra=phase_extra(profile))
+
+    scan = staged("scan", run_scan_stage, scan_rows, batch_size=1 << 20)
+    if scan is not None:
+        out["scan_rows_per_sec_per_chip"] = round(scan["rows_per_sec"], 1)
+        out["scan_vs_baseline"] = round(scan["vs_single_core"], 2)
+        checkpoint("scan", extra=phase_extra(scan))
+
+    device = staged("device_scan", run_device_resident_stage)
+    if device is not None:
+        out["device_scan_rows_per_sec"] = round(device["rows_per_sec"], 1)
+        out["device_scan_gbps"] = round(device["achieved_gbps"], 2)
+        checkpoint("device_scan")
+
+    merge = staged("device_merge", run_device_merge_stage)
+    if merge is not None:
+        out["sketch_merge_gbps"] = round(merge["kll"], 3)
+        out["hll_merge_gbps"] = round(merge["hll"], 3)
+        checkpoint("device_merge")
 
     incremental = staged(
         "incremental", run_incremental_stage,

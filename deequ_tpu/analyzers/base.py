@@ -245,6 +245,21 @@ class Analyzer(abc.ABC, Generic[S, M]):
     def merge(self, a: S, b: S) -> S:  # pragma: no cover - overridden
         raise NotImplementedError
 
+    # slim state fetch -------------------------------------------------------
+
+    def metric_leaves(self) -> Optional[Sequence[int]]:
+        """Indices (into the flattened state pytree, ``tree_flatten`` order)
+        of the leaves ``compute_metric_from`` actually reads, or ``None``
+        when every leaf is metric-bearing (the safe default).
+
+        The engine's slim fetch uses this on runs that neither persist nor
+        aggregate states: only the named leaves cross the device feed link;
+        the rest are reconstructed host-side from ``init_state`` identity
+        values the metric never touches. An analyzer overriding this
+        GUARANTEES its metric (and ``is_empty``) never read an excluded
+        leaf."""
+        return None
+
 
 #: jit'd per-analyzer state-fold programs, keyed by (analyzer, shard count);
 #: bounded LRU so a long-lived service cycling through many analyzer
@@ -494,6 +509,17 @@ class ScanShareableAnalyzer(Analyzer[S, M]):
     @abc.abstractmethod
     def feature_specs(self) -> List[FeatureSpec]:
         ...
+
+    def scan_program_key(self) -> Tuple:
+        """Extra program-identity key for the bundled device scan. Two
+        analyzers sharing (class, feature-spec kinds, state shapes) AND this
+        tuple run through ONE compiled update program with their feature
+        arrays remapped positionally — so any instance parameter that alters
+        the TRACED update logic beyond what state shapes and feature values
+        express MUST appear here. Column names, where-filters, predicates,
+        regexes and quantile points all act host-side (feature computation)
+        or at metric time, so the default is empty."""
+        return ()
 
     @abc.abstractmethod
     def init_state(self) -> S:

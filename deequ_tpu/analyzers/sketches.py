@@ -364,6 +364,14 @@ class _KLLBackedAnalyzer(ScanShareableAnalyzer[KLLSketchState, KLLMetric]):
     def merge(self, a, b):
         return kll_merge(a, b)
 
+    def metric_leaves(self):
+        # KLLSketchState flattens as (items, sizes, parity, ticks, count,
+        # g_min, g_max); the metric (HostKLL ranks/quantiles + the
+        # compactor-buffer payload) reads everything EXCEPT the compaction
+        # parity offsets and the update tick counter, which only steer
+        # FUTURE folds/merges — the slim fetch drops them.
+        return (0, 1, 4, 5, 6)
+
     supports_host_partial = True
 
     def host_partial(self, ctx):
@@ -564,19 +572,97 @@ def _check_quantile(q: float) -> None:
 def _check_relative_error(relative_error: float) -> None:
     """The reference admits relativeError=0 as 'exact' GK mode
     (`ApproxQuantiles.scala:30`); a KLL sketch cannot be exact in bounded
-    memory, so the accepted interval here is half-open (0, 1] with 1e-4 as
-    the smallest honored error."""
-    if not 0.0 < relative_error <= 1.0:
+    memory, so ``relative_error=0.0`` here routes the analyzer to a HOST
+    full-sort accumulator (see :class:`ExactQuantileState`) whose result
+    matches ``numpy.quantile`` exactly at O(n) host memory. Errors in
+    (0, 1] stay KLL-backed, with 1e-4 as the smallest honored error."""
+    if not 0.0 <= relative_error <= 1.0:
         raise IllegalAnalyzerParameterException(
-            "Relative error parameter must be in the interval (0, 1]. "
+            "Relative error parameter must be in the interval [0, 1]. "
             f"Currently, the value is: {relative_error}!"
         )
 
 
 @dataclass(frozen=True)
-class ApproxQuantile(_KLLBackedAnalyzer, StandardScanShareableAnalyzer[KLLSketchState]):
+class ExactQuantileState:
+    """Host accumulator for ``relative_error=0.0`` (the reference's "exact"
+    GK mode, `ApproxQuantiles.scala:30`): chunks of the column's non-null,
+    non-NaN values, concatenated and full-sorted at metric time so the
+    result is bit-identical to ``numpy.quantile`` (linear interpolation).
+    Memory is O(values retained) — the documented price of exactness; the
+    merge is chunk-list concatenation, so in-memory partition states
+    aggregate like any other semigroup state. NOT registered with the
+    state-persistence codec: persisting raw column values as "state"
+    defeats the sketch contract, and ``save_states_with`` on an exact
+    analyzer degrades to a typed failure metric naming the unregistered
+    type."""
+
+    chunks: Tuple[np.ndarray, ...] = ()
+
+    def add(self, values: np.ndarray) -> "ExactQuantileState":
+        return ExactQuantileState(
+            self.chunks + (np.asarray(values, dtype=np.float64),)
+        )
+
+    def merge(self, other: "ExactQuantileState") -> "ExactQuantileState":
+        return ExactQuantileState(self.chunks + other.chunks)
+
+    @property
+    def count(self) -> int:
+        return int(sum(c.size for c in self.chunks))
+
+    def values(self) -> np.ndarray:
+        if not self.chunks:
+            return np.empty(0, dtype=np.float64)
+        return np.concatenate(self.chunks)
+
+
+class _ExactQuantileMode:
+    """Exact-mode plumbing shared by ApproxQuantile(+s): with
+    ``relative_error == 0.0`` the analyzer leaves the fused scan
+    (``host_exclusive``) and accumulates raw values host-side through the
+    shared pass — still ONE pass over the data, like every other
+    accumulator."""
+
+    @property
+    def host_exclusive(self) -> bool:
+        return self.relative_error == 0.0
+
+    def host_init(self) -> ExactQuantileState:
+        return ExactQuantileState()
+
+    def host_update(self, state: ExactQuantileState, batch) -> ExactQuantileState:
+        col = batch.column(self.column)
+        mask = batch.row_mask & col.mask
+        if self.where is not None:
+            from ..expr import evaluate_predicate
+            from ..runners.features import _predicate_columns
+
+            mask = mask & evaluate_predicate(
+                self.where, _predicate_columns(batch), len(batch.row_mask)
+            )
+        vals = (
+            col.values
+            if np.issubdtype(col.values.dtype, np.number)
+            else col.numeric_f64()
+        )
+        v = np.asarray(vals, dtype=np.float64)[mask]
+        v = v[~np.isnan(v)]
+        return state.add(v) if v.size else state
+
+    def merge(self, a, b):
+        if isinstance(a, ExactQuantileState) or isinstance(b, ExactQuantileState):
+            return a.merge(b)
+        return kll_merge(a, b)
+
+
+@dataclass(frozen=True)
+class ApproxQuantile(
+    _ExactQuantileMode, _KLLBackedAnalyzer, StandardScanShareableAnalyzer[KLLSketchState]
+):
     """Approximate single quantile (reference `analyzers/ApproxQuantile.scala:
-    28-103`, default relativeError 0.01 at `:49`), KLL-backed."""
+    28-103`, default relativeError 0.01 at `:49`), KLL-backed;
+    ``relative_error=0.0`` selects the exact host full-sort mode."""
 
     column: str = ""
     quantile: float = 0.5
@@ -599,17 +685,20 @@ class ApproxQuantile(_KLLBackedAnalyzer, StandardScanShareableAnalyzer[KLLSketch
 
         return [param_checks] + super().preconditions()
 
-    def metric_value(self, state: KLLSketchState) -> float:
+    def metric_value(self, state) -> float:
+        if isinstance(state, ExactQuantileState):
+            return float(np.quantile(state.values(), self.quantile))
         return HostKLL.from_state(state).quantile(self.quantile)
 
-    def is_empty(self, state: KLLSketchState) -> bool:
+    def is_empty(self, state) -> bool:
         return int(state.count) == 0
 
 
 @dataclass(frozen=True)
-class ApproxQuantiles(_KLLBackedAnalyzer):
+class ApproxQuantiles(_ExactQuantileMode, _KLLBackedAnalyzer):
     """Several quantiles from one sketch -> KeyedDoubleMetric
-    (reference `analyzers/ApproxQuantiles.scala:39-101`)."""
+    (reference `analyzers/ApproxQuantiles.scala:39-101`);
+    ``relative_error=0.0`` selects the exact host full-sort mode."""
 
     column: str = ""
     quantiles: Tuple[float, ...] = ()
@@ -637,6 +726,14 @@ class ApproxQuantiles(_KLLBackedAnalyzer):
             empty = metric_from_empty(self.name, self.column, Entity.COLUMN)
             return KeyedDoubleMetric(Entity.COLUMN, self.name, self.column, empty.value)
         try:
+            if isinstance(state, ExactQuantileState):
+                vals = state.values()
+                values = {
+                    str(q): float(np.quantile(vals, q)) for q in self.quantiles
+                }
+                return KeyedDoubleMetric(
+                    Entity.COLUMN, self.name, self.column, Success(values)
+                )
             sketch = HostKLL.from_state(state)
             values = {str(q): sketch.quantile(q) for q in self.quantiles}
             return KeyedDoubleMetric(Entity.COLUMN, self.name, self.column, Success(values))

@@ -38,3 +38,40 @@ COUNT_DTYPE = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 #: default number of rows per device batch fed to the fused update program
 DEFAULT_BATCH_SIZE = 1 << 20
+
+# ---------------------------------------------------------------------------
+# Device scan-program bundling + slim state fetch (read per call, not at
+# import, so tests and operators can flip them without re-importing jax)
+# ---------------------------------------------------------------------------
+
+#: env var sizing the signature-keyed device scan bundles: a battery is
+#: partitioned into (analyzer-class, state-shape) bundles of at most this
+#: many analyzers, each compiled as ONE small PackedScanProgram that is
+#: REUSED across columns, batteries and runs (a 50-column profile compiles
+#: ~10 small programs instead of one monolithic one). "0" restores the
+#: monolithic one-program-per-battery behavior (maximum fusion, maximum
+#: cold-compile stall).
+SCAN_BUNDLE_ENV = "DEEQU_TPU_SCAN_BUNDLE"
+DEFAULT_SCAN_BUNDLE = 8
+
+
+def scan_bundle_size() -> int:
+    raw = os.environ.get(SCAN_BUNDLE_ENV)
+    if raw is None:
+        return DEFAULT_SCAN_BUNDLE
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_SCAN_BUNDLE
+
+
+#: env var disabling the slim state fetch ("0" = always fetch full states).
+#: When enabled (default), a run that neither persists nor aggregates
+#: states ships only each analyzer's METRIC-BEARING state leaves over the
+#: device feed link (see Analyzer.metric_leaves); the remaining leaves are
+#: reconstructed host-side from identity values the metric never reads.
+SLIM_FETCH_ENV = "DEEQU_TPU_SLIM_FETCH"
+
+
+def slim_fetch_enabled() -> bool:
+    return os.environ.get(SLIM_FETCH_ENV, "1") != "0"

@@ -182,7 +182,16 @@ def sharded_ingest_fold(
 
     ``states_stacked``: tuple (per analyzer) of pytrees with leading n_dev
     dim. Returns the updated stacked states."""
-    key = (tuple(analyzers), tuple(mesh.devices.flat))
+    from ..runners.engine import _ingest_signature
+
+    # keyed by ingest SIGNATURES, not analyzer identities: same-class/
+    # same-shape batteries over different columns share one compiled
+    # sharded fold (the mesh-path analog of the bundled device programs —
+    # ingest_partial is a pure function of class + state/partial shapes)
+    key = (
+        tuple(_ingest_signature(a) for a in analyzers),
+        tuple(mesh.devices.flat),
+    )
     program = _SHARDED_INGEST_CACHE.get(key)
     if program is None:
         def spec_of(tree):
@@ -306,12 +315,22 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
 
     # cache the jitted program: the closure is new per call, so without this
     # every merge invocation RECOMPILED the whole collective program (tens
-    # of seconds of XLA work for a 27-analyzer battery)
+    # of seconds of XLA work for a 27-analyzer battery). Keyed by ingest
+    # SIGNATURES (class + state shapes), not analyzer identities, so
+    # same-shape batteries over different columns share one collective —
+    # semigroup ``merge`` is a pure function of class + state shapes.
+    from ..runners.engine import _ingest_signature
+
     shape_sig = tuple(
         (leaf.shape, np.dtype(leaf.dtype).str)
         for leaf in jax.tree_util.tree_leaves(padded)
     )
-    cache_key = (tuple(analyzers), tuple(mesh.devices.flat), k, shape_sig)
+    cache_key = (
+        tuple(_ingest_signature(a) for a in analyzers),
+        tuple(mesh.devices.flat),
+        k,
+        shape_sig,
+    )
     program = _COLLECTIVE_MERGE_CACHE.get(cache_key)
     if program is None:
         shard_spec = jax.tree_util.tree_map(

@@ -264,18 +264,43 @@ class ColumnProfiler:
                 data.derived_cache[ekey] = encoded
             casted = encoded
         second_pass += [Histogram(name) for name in shared_hist]
-        second_results = (
-            AnalysisRunner.do_analysis_run(casted, second_pass, **run_kwargs)
-            if second_pass
-            else None
-        )
-        third_results = (
-            AnalysisRunner.do_analysis_run(
-                data, [Histogram(name) for name in extra_hist], **run_kwargs
-            )
-            if extra_hist
-            else None
-        )
+        second_results = None
+        third_results = None
+        extra_hist_pass = [Histogram(name) for name in extra_hist]
+        if (
+            second_pass
+            and extra_hist_pass
+            and run_kwargs.get("save_or_append_results_with_key") is None
+        ):
+            # the two pass-2 scans are INDEPENDENT (numeric stats over the
+            # casted view vs raw-value histograms of casted columns), so
+            # they overlap: one thread's state fetch rides the feed link
+            # while the other's batches stream through the async device
+            # queue — the 2-pass overlap the slim-fetch redesign calls for.
+            # (With a repository save key the runs stay sequential: the
+            # append path is read-modify-write on the shared repository.)
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="deequ-profile-pass"
+            ) as pool:
+                second_future = pool.submit(
+                    AnalysisRunner.do_analysis_run, casted, second_pass,
+                    **run_kwargs,
+                )
+                third_results = AnalysisRunner.do_analysis_run(
+                    data, extra_hist_pass, **run_kwargs
+                )
+                second_results = second_future.result()
+        else:
+            if second_pass:
+                second_results = AnalysisRunner.do_analysis_run(
+                    casted, second_pass, **run_kwargs
+                )
+            if extra_hist_pass:
+                third_results = AnalysisRunner.do_analysis_run(
+                    data, extra_hist_pass, **run_kwargs
+                )
 
         numeric_stats = _extract_numeric_statistics(first_results, second_results)
         histograms: Dict[str, Distribution] = {}

@@ -184,7 +184,7 @@ def run_scan_resilient(
                     "fused battery of %d analyzers failed (%s: %s); "
                     "bisecting to isolate", len(part), type(exc).__name__, exc,
                 )
-            monitor.isolation_reruns += 1
+            monitor.bump("isolation_reruns")
             mid = len(part) // 2
             left, right = part[:mid], part[mid:]
             failed_left, sig_left = run_partition(left)
@@ -269,7 +269,7 @@ def _attempt_tiered(
             ):
                 oom_left -= 1
                 bs //= 2
-                monitor.batch_bisections += 1
+                monitor.bump("batch_bisections")
                 _logger.warning(
                     "device OOM (%s); bisecting batch size to %d", exc, bs
                 )
@@ -278,7 +278,7 @@ def _attempt_tiered(
                 host_states = _refresh_host_states(host_states, monitor)
                 continue
             if kind in ("oom", "device") and placement_now != "host" and host_capable:
-                monitor.device_failovers += 1
+                monitor.bump("device_failovers")
                 monitor.note_degraded(f"tier:device->{kind}")
                 _logger.warning(
                     "device tier failed (%s: %s); failing battery of %d "

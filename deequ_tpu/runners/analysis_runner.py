@@ -135,7 +135,31 @@ class AnalysisRunner:
             DeviceFrequencyScan,
         )
 
-        scanning = [a for a in passed if isinstance(a, ScanShareableAnalyzer)]
+        # host-exclusive analyzers (e.g. exact-quantile mode, whose
+        # accumulator is unbounded and has no fixed-shape device fold) opt
+        # out of the fused scan even though their class is scan-shareable.
+        # Their raw-value states are deliberately NOT in the persistence
+        # registry, so a configured checkpointer would blow up on its first
+        # save; drop it with a warning instead (the same degradation the
+        # mesh path applies), keeping the run correct end to end.
+        if checkpointer is not None and any(
+            getattr(a, "host_exclusive", False) for a in passed
+        ):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ingest checkpointing is not supported with host-exclusive "
+                "analyzers (e.g. exact-quantile mode, whose raw-value "
+                "states are not persistable); running without checkpoints"
+            )
+            checkpointer = None
+        scanning = [
+            a
+            for a in passed
+            if isinstance(a, ScanShareableAnalyzer)
+            and not getattr(a, "host_exclusive", False)
+        ]
+        scanning_set = set(scanning)
         grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
         # binning-free Histograms over small-dictionary columns share the
         # device frequency scan instead of accumulating a host group-by per
@@ -157,11 +181,12 @@ class AnalysisRunner:
             if hasattr(a, "host_init")
             and not isinstance(a, GroupingAnalyzer)
             and a not in device_hist_set
+            and a not in scanning_set
         ]
         others = [
             a
             for a in passed
-            if a not in scanning
+            if a not in scanning_set
             and a not in grouping
             and a not in host_accum
             and a not in device_hist_set
@@ -225,6 +250,15 @@ class AnalysisRunner:
             from .engine import effective_batch_size
 
             full_battery = tuple(scan_battery)
+            # slim fetch: when nothing downstream needs the full states
+            # (no persistence, no cross-run aggregation, no checkpoint),
+            # each analyzer ships only its metric-bearing leaves back over
+            # the feed link (engine._fetch_states_packed's analyzers arg)
+            slim = (
+                aggregate_with is None
+                and save_states_with is None
+                and checkpointer is None
+            )
 
             def run_pass(part, hs, hu, *, placement=None, batch_size=None):
                 engine = ScanEngine(
@@ -243,6 +277,7 @@ class AnalysisRunner:
                 return engine.run(
                     data, batch_size=batch_size, host_accumulators=hs,
                     host_update_fns=hu, columns=cols, checkpointer=ckpt,
+                    slim_fetch=slim,
                 )
 
             outcome = run_scan_resilient(
@@ -401,6 +436,10 @@ def _columns_needed(engine: ScanEngine, grouping_sets, host_accum, schema) -> Op
     for set_cols in grouping_sets:
         cols.update(set_cols)
     for a in host_accum:
+        if getattr(a, "where", None) is not None:
+            # a host-accumulated where-filter evaluates its predicate over
+            # raw batch columns, which may reference any column
+            return None
         cols.add(a.column)
     if not cols:
         return []

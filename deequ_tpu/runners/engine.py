@@ -54,6 +54,12 @@ class RunMonitor:
     batches: int = 0
     device_updates: int = 0
     jit_compiles: int = 0
+    #: XLA program traces NEWLY paid during this monitor's runs (a DELTA,
+    #: unlike ``jit_compiles`` which mirrors the absolute program-cache
+    #: occupancy): a warm re-run of the same battery records 0 here. The
+    #: compile-budget regression test and the bench's per-stage artifact
+    #: key on this.
+    program_compiles: int = 0
     placement: Optional[str] = None
     feed_bandwidth_mbps: Optional[float] = None
     phase_seconds: Dict[str, float] = field(default_factory=dict)
@@ -69,6 +75,7 @@ class RunMonitor:
         self.batches = 0
         self.device_updates = 0
         self.jit_compiles = 0
+        self.program_compiles = 0
         self.placement = None
         self.feed_bandwidth_mbps = None
         self.phase_seconds = {}
@@ -82,6 +89,13 @@ class RunMonitor:
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
             self.degraded.append(tag)
+
+    def bump(self, field_name: str, by: int = 1) -> None:
+        """Locked counter increment: overlapped profile passes share one
+        monitor across threads, and `+=` on a dataclass int is not
+        atomic."""
+        with _MONITOR_LOCK:
+            setattr(self, field_name, getattr(self, field_name) + by)
 
     def add_phase_time(self, phase: str, seconds: float) -> None:
         with _MONITOR_LOCK:
@@ -129,13 +143,15 @@ class _PhaseTimer:
         return False
 
 
-#: jit'd fused programs keyed by (analyzer battery, mesh) — analyzers are
-#: frozen dataclasses, so identical batteries across runs reuse the SAME
-#: compiled XLA program instead of re-tracing a fresh closure (re-compiles
-#: cost tens of seconds for large batteries). LRU-bounded so a long-lived
-#: multi-tenant service cycling through many distinct batteries cannot
-#: grow program/device memory monotonically; an evicted battery simply
-#: reads as cold again and re-warms through the placement router.
+#: battery-level scan orchestrators keyed by (analyzer battery, mesh) —
+#: analyzers are frozen dataclasses, so identical batteries across runs
+#: reuse the SAME BundledScanProgram (whose `executed` flag carries the
+#: service's warmth semantics). The COMPILED units live one level down in
+#: _BUNDLE_PROGRAM_CACHE, keyed by signature so different batteries share
+#: them. LRU-bounded so a long-lived multi-tenant service cycling through
+#: many distinct batteries cannot grow program/device memory monotonically;
+#: an evicted battery simply reads as cold again and re-warms through the
+#: placement router.
 from ..utils import BoundedLRU as _BoundedLRU  # noqa: E402
 
 _PROGRAM_CACHE = _BoundedLRU(256)
@@ -162,7 +178,16 @@ class PackedScanProgram:
     (jit'd slices + casts, negligible) restores the ordinary state pytrees
     for the fetch/merge paths, so everything outside the hot loop keeps the
     plain-state protocol.
-    """
+
+    COLUMN-AGNOSTIC TRACE: the jit'd update consumes per-slot POSITIONAL
+    feature tuples, and the traced body rebuilds each slot's features dict
+    from this program's own analyzers' spec keys. Feature arrays are thereby
+    remapped positionally, so one compiled program serves EVERY battery
+    whose per-slot (class, feature kinds, state shapes) signatures match —
+    ``Mean("a")`` and ``Mean("z")`` run the same XLA executable. This is
+    what lets the signature-keyed bundle cache share programs across
+    columns, batteries and the suggestion stage (the device-tier analog of
+    the host ingest tier's signature bundling)."""
 
     def __init__(self, analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
         self.analyzers = analyzers
@@ -171,6 +196,12 @@ class PackedScanProgram:
         #: compiles lazily, so mere construction leaves the program cold —
         #: warmth claims (the service's cache-aware placement) key on this
         self.executed = False
+        #: per-slot feature keys of the TEMPLATE analyzers this program was
+        #: traced with; callers with same-signature batteries feed arrays
+        #: positionally and the trace rebinds them under these keys
+        self._spec_keys = [
+            tuple(spec.key for spec in a.feature_specs()) for a in analyzers
+        ]
 
         init_shapes = jax.eval_shape(
             lambda: tuple(a.init_state() for a in analyzers)
@@ -193,11 +224,17 @@ class PackedScanProgram:
         self._ivec_dtype = COUNT_DTYPE
 
         pack, unpack = self._pack, self._unpack
+        spec_keys = self._spec_keys
 
-        def fused_update(carry, features: Dict[str, jax.Array]):
+        def fused_update(carry, slot_features):
             states = unpack(carry)
             return pack(
-                tuple(a.update(s, features) for a, s in zip(analyzers, states))
+                tuple(
+                    a.update(s, dict(zip(keys, feats)))
+                    for a, keys, s, feats in zip(
+                        analyzers, spec_keys, states, slot_features
+                    )
+                )
             )
 
         if mesh is None:
@@ -248,7 +285,18 @@ class PackedScanProgram:
         return self._init_jit()
 
     def __call__(self, carry, features: Dict[str, jax.Array]):
-        out = self._update(carry, features)
+        """Dispatch one batch with a GLOBAL features dict (keys = this
+        program's own analyzers' spec keys — the monolithic/bench entry)."""
+        slots = tuple(
+            tuple(features[k] for k in keys) for keys in self._spec_keys
+        )
+        return self.call_with_slots(carry, slots)
+
+    def call_with_slots(self, carry, slot_features):
+        """Dispatch one batch with PRE-GATHERED per-slot feature tuples (the
+        bundled entry: the caller gathered them via its OWN analyzers' spec
+        keys, positionally parallel to this program's template specs)."""
+        out = self._update(carry, slot_features)
         self.executed = True  # the jit call above traced + compiled
         return out
 
@@ -267,8 +315,211 @@ class PackedScanProgram:
         return self._update._cache_size()
 
 
+#: signature-keyed bundle programs: the compiled-XLA sharing layer. Keys are
+#: tuples of per-slot scan signatures + mesh, NOT analyzer identities, so
+#: ``(Mean("a"), Mean("b"))`` and ``(Mean("x"), Mean("y"))`` — and the same
+#: classes inside a different battery, or the suggestion stage's evaluation
+#: batteries — all resolve to ONE PackedScanProgram. Sized above the
+#: battery-level cache: bundles are the scarcer, more reusable resource.
+_BUNDLE_PROGRAM_CACHE = _BoundedLRU(512)
+
+_SCAN_SIG_CACHE = _BoundedLRU(4096)
+
+
+def _scan_signature(a: ScanShareableAnalyzer) -> Tuple:
+    """Program-identity key of an analyzer's fused-scan update: the ingest
+    signature (class + state tree structure + leaf shapes/dtypes) extended
+    with the feature-spec KIND tuple (a where-filter adds a predicate
+    feature, changing the traced update) and the analyzer's own
+    ``scan_program_key`` escape hatch. Valid because every ``update`` is a
+    pure function of the state and feature VALUES given that key: columns,
+    predicates, regexes and quantile points act host-side (feature
+    computation) or at metric time, never inside the trace."""
+    sig = _SCAN_SIG_CACHE.get(a)
+    if sig is None:
+        keys = [spec.key for spec in a.feature_specs()]
+        sig = _ingest_signature(a) + (
+            tuple(spec.kind for spec in a.feature_specs()),
+            # the key-DUPLICATION pattern: the traced update rebinds slot
+            # arrays under the template's keys via dict(zip(keys, feats)),
+            # so an analyzer whose specs repeat a key (e.g. where ==
+            # predicate) collapses positions a distinct-key analyzer keeps
+            # separate — they must not share a program
+            tuple(keys.index(k) for k in keys),
+            a.scan_program_key(),
+        )
+        _SCAN_SIG_CACHE[a] = sig
+    return sig
+
+
+def _signature_bundles(analyzers, sig_fn, bundle_size: int):
+    """Partition analyzer indices into signature-homogeneous bundles of at
+    most ``bundle_size``, preserving relative order within a signature;
+    returns (indices, n_real) pairs. Pad positions (j >= n_real) re-fold a
+    REPEAT of the bundle's first index and their outputs MUST be discarded
+    by the caller. Two padding rules bound the compiled-shape space per
+    signature to log2(bundle_size)+1 variants while keeping pad waste < 2x:
+
+    - a signature spanning MORE than one bundle pads its tail to the full
+      ``bundle_size`` so the tail reuses the full-size compiled program
+      instead of compiling a second length variant;
+    - a LONE small group pads to the next power of two, so batteries with
+      nearby same-class counts (pass-2 numeric batteries, suggestion
+      evaluation subsets) converge on the same program shapes instead of
+      compiling one program per exact count.
+
+    Shared by the host ingest tier and the device scan bundling so the two
+    partitioning policies cannot drift."""
+    by_sig: Dict[Tuple, List[int]] = {}
+    for i, a in enumerate(analyzers):
+        by_sig.setdefault(sig_fn(a), []).append(i)
+    bundles: List[Tuple[List[int], int]] = []
+    for idxs in by_sig.values():
+        for j in range(0, len(idxs), bundle_size):
+            part = idxs[j : j + bundle_size]
+            n_real = len(part)
+            if j > 0 and n_real < bundle_size:
+                part = part + [idxs[0]] * (bundle_size - n_real)
+            elif j == 0 and n_real < bundle_size:
+                slots = 1
+                while slots < n_real:
+                    slots *= 2
+                part = part + [idxs[0]] * (slots - n_real)
+            bundles.append((part, n_real))
+    return bundles
+
+
+def _bundle_program(
+    bundle_analyzers: Tuple[ScanShareableAnalyzer, ...], mesh
+) -> PackedScanProgram:
+    """The signature-cached PackedScanProgram for one bundle. The stored
+    program was traced with the FIRST battery's analyzers that materialized
+    this key (the templates); every later same-signature bundle feeds its
+    feature arrays positionally through ``call_with_slots``. Callers hold
+    _PROGRAM_CACHE_LOCK."""
+    key = (
+        tuple(_scan_signature(a) for a in bundle_analyzers),
+        None if mesh is None else tuple(mesh.devices.flat),
+    )
+    cached = _BUNDLE_PROGRAM_CACHE.get(key)
+    if cached is None:
+        fault_point("compile", tag=str(len(bundle_analyzers)))
+        cached = PackedScanProgram(bundle_analyzers, mesh)
+        _BUNDLE_PROGRAM_CACHE[key] = cached
+    return cached
+
+
+class BundledScanProgram:
+    """Battery-level orchestrator over signature-keyed bundle programs.
+
+    The monolithic PackedScanProgram keys its compile on the full analyzer
+    tuple, so a cold 50-column profile battery pays one giant XLA compile
+    (measured 1140.6s staging vs 1.98s warm — 575x, BENCH_r05) that nothing
+    else can reuse. This splits the battery into (class, state-shape)
+    signature bundles of at most ``config.scan_bundle_size()`` analyzers:
+    each bundle compiles a SMALL program cached by signature, so a 50-column
+    profile compiles ~10 programs that are shared across its own columns,
+    across batteries, across the profiler's passes and the suggestion stage
+    — and, via jax's persistent compilation cache, across processes. The
+    packed-carry fusion win survives WITHIN each bundle (same-class sibling
+    reductions share one output root); what is traded away is cross-class
+    fusion over one column, bought back many times over in compile time.
+
+    ``DEEQU_TPU_SCAN_BUNDLE=0`` restores the monolithic single-bundle
+    behavior (the parity baseline the bundled path is tested bit-identical
+    against).
+
+    Presents the same interface the engine drives (`init_carry` /
+    ``__call__`` / `unpack` / `pack_states` / `_cache_size`); the carry is a
+    tuple of per-bundle packed carries."""
+
+    def __init__(self, analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
+        from ..config import scan_bundle_size
+
+        self.analyzers = analyzers
+        self.mesh = mesh
+        #: battery-level warmth: True once THIS battery dispatched. Shared
+        #: bundle programs may already be compiled (that is the point), but
+        #: warmth introspection stays conservative at battery granularity so
+        #: the service's placement probes keep their lazy-compile semantics.
+        self.executed = False
+        bundle_size = scan_bundle_size()
+        if bundle_size <= 0:
+            self._bundles = [(list(range(len(analyzers))), len(analyzers))]
+        else:
+            self._bundles = _signature_bundles(
+                analyzers, _scan_signature, bundle_size
+            )
+        self._programs = [
+            _bundle_program(tuple(analyzers[i] for i in idxs), mesh)
+            for idxs, _ in self._bundles
+        ]
+        #: per-bundle, per-slot feature keys of the ACTUAL analyzers —
+        #: gathered from the global features dict at dispatch and fed
+        #: positionally to the (possibly template-traced) bundle program
+        self._slot_keys = [
+            [
+                tuple(spec.key for spec in analyzers[i].feature_specs())
+                for i in idxs
+            ]
+            for idxs, _ in self._bundles
+        ]
+
+    def init_carry(self):
+        return tuple(prog.init_carry() for prog in self._programs)
+
+    def __call__(self, carry, features: Dict[str, jax.Array]):
+        out = []
+        for c, prog, keys in zip(carry, self._programs, self._slot_keys):
+            slots = tuple(tuple(features[k] for k in slot) for slot in keys)
+            out.append(prog.call_with_slots(c, slots))
+        self.executed = True
+        return tuple(out)
+
+    def unpack(self, carry) -> Tuple:
+        """Per-analyzer state pytrees in battery order (pad slots, which
+        re-folded a duplicate of their bundle's first analyzer, are
+        discarded)."""
+        out: List[Any] = [None] * len(self.analyzers)
+        for (idxs, n_real), prog, c in zip(self._bundles, self._programs, carry):
+            states = prog.unpack(c)
+            for j in range(n_real):
+                out[idxs[j]] = states[j]
+        return tuple(out)
+
+    def pack_states(self, states: Tuple):
+        """Inverse of :meth:`unpack` (checkpoint resume): pad slots are
+        refilled with their bundle's first state, mirroring what the fold
+        would have computed for them."""
+        states = tuple(states)
+        return tuple(
+            prog.pack_states(tuple(states[i] for i in idxs))
+            for (idxs, _), prog in zip(self._bundles, self._programs)
+        )
+
+    def _distinct_programs(self) -> List[PackedScanProgram]:
+        seen: Dict[int, PackedScanProgram] = {}
+        for prog in self._programs:
+            seen.setdefault(id(prog), prog)
+        return list(seen.values())
+
+    def _cache_size(self) -> int:
+        return sum(p._cache_size() for p in self._distinct_programs())
+
+
 def _program_cache_key(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh) -> Tuple:
-    return (analyzers, None if mesh is None else tuple(mesh.devices.flat))
+    from ..config import scan_bundle_size
+
+    # bundle size joins the key: an orchestrator bakes its partitioning in
+    # __init__, so a DEEQU_TPU_SCAN_BUNDLE flip mid-process must MISS the
+    # battery cache and re-partition instead of silently serving the old
+    # layout (config.py promises the knob is honored without re-import,
+    # and the bundled-vs-monolithic parity tests depend on it)
+    return (
+        analyzers,
+        None if mesh is None else tuple(mesh.devices.flat),
+        scan_bundle_size(),
+    )
 
 
 def _fused_program(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
@@ -279,8 +530,7 @@ def _fused_program(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh):
     with _PROGRAM_CACHE_LOCK:
         cached = _PROGRAM_CACHE.get(key)
         if cached is None:
-            fault_point("compile", tag=str(len(analyzers)))
-            cached = PackedScanProgram(analyzers, mesh)
+            cached = BundledScanProgram(analyzers, mesh)
             _PROGRAM_CACHE[key] = cached
         return cached
 
@@ -501,6 +751,60 @@ def _restore_kll_width(fetched: List[Any], widths: List[Optional[int]]) -> List[
     return fetched
 
 
+#: host-side identity leaf values per scan signature: the slim fetch
+#: reconstructs non-metric-bearing leaves from these instead of hauling
+#: them over the feed link. One device round trip per SIGNATURE per
+#: process (not per analyzer per pass).
+_HOST_INIT_LEAVES = _BoundedLRU(1024)
+
+
+def _host_init_leaf_values(a) -> List[np.ndarray]:
+    key = _scan_signature(a)
+    cached = _HOST_INIT_LEAVES.get(key)
+    if cached is None:
+        cached = [
+            np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(a.init_state())
+        ]
+        _HOST_INIT_LEAVES[key] = cached
+    return cached
+
+
+def _slim_metric_leaves(analyzers, states: Tuple):
+    """Replace each analyzer's NON-metric-bearing state leaves (per
+    ``Analyzer.metric_leaves``) with zero-size placeholders so they cost
+    nothing on the feed link; returns (slimmed states, restore plan). Only
+    called on runs that neither persist nor aggregate states — the metric
+    never reads the dropped leaves, so reconstructing them from identity
+    values (:func:`_restore_slim_leaves`) is observationally lossless."""
+    plan: List[Tuple[int, List[int]]] = []
+    out = list(states)
+    for i, a in enumerate(analyzers):
+        idx = a.metric_leaves()
+        if idx is None:
+            continue
+        leaves, treedef = jax.tree_util.tree_flatten(out[i])
+        keep = {int(j) for j in idx}
+        dropped = [j for j in range(len(leaves)) if j not in keep]
+        if not dropped:
+            continue
+        for j in dropped:
+            leaves[j] = jnp.zeros((0,), jnp.asarray(leaves[j]).dtype)
+        out[i] = jax.tree_util.tree_unflatten(treedef, leaves)
+        plan.append((i, dropped))
+    return tuple(out), plan
+
+
+def _restore_slim_leaves(analyzers, fetched: List[Any], plan) -> List[Any]:
+    for i, dropped in plan:
+        init_leaves = _host_init_leaf_values(analyzers[i])
+        leaves, treedef = jax.tree_util.tree_flatten(fetched[i])
+        for j in dropped:
+            leaves[j] = init_leaves[j]
+        fetched[i] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return fetched
+
+
 #: floor on statically-slimmed KLL item bytes below which the two-phase
 #: fetch is never considered (the economic gate below also weighs the
 #: probed link bandwidth/latency)
@@ -511,7 +815,7 @@ _TWO_PHASE_KLL_BYTES = 1 << 20
 _TWO_PHASE_EXPECTED_SAVING = 0.6
 
 
-def _fetch_states_packed(states: Tuple) -> List[Any]:
+def _fetch_states_packed(states: Tuple, analyzers=None) -> List[Any]:
     """Device states -> host numpy pytrees via packed D2H transfers.
 
     In x64 mode, leaves that are natively <= 32-bit (KLL item buffers are
@@ -522,10 +826,28 @@ def _fetch_states_packed(states: Tuple) -> List[Any]:
     KLL item buffers additionally ship only their occupied column range
     (see _slim_kll_for_fetch) and are re-padded host-side; when the
     battery carries enough sketch bytes, the two-phase variant also drops
-    every level row above the deepest occupied one."""
+    every level row above the deepest occupied one.
+
+    With ``analyzers`` (the SLIM fetch — runs that neither persist nor
+    aggregate states), each analyzer's non-metric-bearing leaves are
+    dropped from the transfer entirely and reconstructed host-side from
+    identity values (see ``Analyzer.metric_leaves``); everything above
+    composes on top."""
     from ..ops.kll import KLLSketchState
 
     fault_point("state_fetch")
+    slim_plan = None
+    if analyzers is not None:
+        from ..config import slim_fetch_enabled
+
+        if slim_fetch_enabled() and len(analyzers) == len(states):
+            states, slim_plan = _slim_metric_leaves(analyzers, states)
+
+    def finish(fetched: List[Any]) -> List[Any]:
+        if slim_plan:
+            fetched = _restore_slim_leaves(analyzers, fetched, slim_plan)
+        return fetched
+
     kll_idx = [
         i for i, s in enumerate(states)
         if isinstance(s, KLLSketchState)
@@ -544,11 +866,13 @@ def _fetch_states_packed(states: Tuple) -> List[Any]:
         bw_bytes_per_s = probe_feed_bandwidth() * 1e6
         expected_saving_s = _TWO_PHASE_EXPECTED_SAVING * slim_bytes / bw_bytes_per_s
         if expected_saving_s > probe_feed_latency():
-            return _fetch_states_two_phase(states, kll_idx)
+            return finish(_fetch_states_two_phase(states, kll_idx))
     states, kll_widths = _slim_kll_for_fetch(states)
     if any(w is not None for w in kll_widths):
-        return _restore_kll_width(_fetch_states_packed_raw(states), kll_widths)
-    return _fetch_states_packed_raw(states)
+        return finish(
+            _restore_kll_width(_fetch_states_packed_raw(states), kll_widths)
+        )
+    return finish(_fetch_states_packed_raw(states))
 
 
 def _fetch_states_two_phase(states: Tuple, kll_idx: List[int]) -> List[Any]:
@@ -641,19 +965,32 @@ def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
             out_leaves[i] = host.reshape(leaf.shape).copy()
             offset += leaf.size * dtype.itemsize
 
+    def start_d2h(arr):
+        # kick off the device->host copy without blocking, so a second
+        # packed buffer's transfer (and any remaining host work) overlaps
+        # it; np.asarray then completes an already-in-flight copy
+        if hasattr(arr, "copy_to_host_async"):
+            try:
+                arr.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - overlap is best-effort
+                pass
+        return arr
+
     if not x64:
-        unpack_u8(_grouped_leaf_order(leaves), np.asarray(_pack_leaves_u8(leaves)).tobytes())
+        unpack_u8(_grouped_leaf_order(leaves), np.asarray(start_d2h(_pack_leaves_u8(leaves))).tobytes())
         return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
     narrow = [i for i, l in enumerate(leaves) if l.dtype.itemsize <= 4]
     narrow_bytes = sum(leaves[i].size * leaves[i].dtype.itemsize for i in narrow)
     if narrow_bytes < _NARROW_SPLIT_BYTES:
-        unpack_f64(_grouped_leaf_order(leaves), np.asarray(_pack_leaves_f64(leaves)))
+        unpack_f64(_grouped_leaf_order(leaves), np.asarray(start_d2h(_pack_leaves_f64(leaves))))
         return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
     wide = [i for i in range(len(leaves)) if i not in set(narrow)]
-    packed_narrow = _pack_leaves_u8([leaves[i] for i in narrow])
-    packed_wide = _pack_leaves_f64([leaves[i] for i in wide]) if wide else None
+    packed_narrow = start_d2h(_pack_leaves_u8([leaves[i] for i in narrow]))
+    packed_wide = (
+        start_d2h(_pack_leaves_f64([leaves[i] for i in wide])) if wide else None
+    )
     # subset packs reindex their leaf lists, so group over the SUBSET in
     # its original positions — same keys, same encounter order
     unpack_u8(_grouped_leaf_order(leaves, narrow), np.asarray(packed_narrow).tobytes())
@@ -902,26 +1239,9 @@ def _ingest_signature(a: ScanShareableAnalyzer) -> Tuple:
 
 
 def _ingest_bundles(analyzers: Tuple[ScanShareableAnalyzer, ...]):
-    """Partition analyzer indices into signature-homogeneous bundles,
-    preserving relative order within a signature; returns (indices,
-    n_real) pairs. A signature with MORE than one bundle pads its tail to
-    _INGEST_BUNDLE by REPEATING its first index so the tail reuses the
-    full-size compiled program instead of compiling a second length
-    variant; pad positions (j >= n_real) re-fold an already-processed
-    analyzer and their outputs MUST be discarded by the caller. Lone small
-    groups keep their natural size."""
-    by_sig: Dict[Tuple, List[int]] = {}
-    for i, a in enumerate(analyzers):
-        by_sig.setdefault(_ingest_signature(a), []).append(i)
-    bundles: List[Tuple[List[int], int]] = []
-    for idxs in by_sig.values():
-        for j in range(0, len(idxs), _INGEST_BUNDLE):
-            part = idxs[j : j + _INGEST_BUNDLE]
-            n_real = len(part)
-            if j > 0 and n_real < _INGEST_BUNDLE:
-                part = part + [idxs[0]] * (_INGEST_BUNDLE - n_real)
-            bundles.append((part, n_real))
-    return bundles
+    """Signature-homogeneous ingest bundles (see :func:`_signature_bundles`
+    for the partitioning/padding policy, shared with the device scan)."""
+    return _signature_bundles(analyzers, _ingest_signature, _INGEST_BUNDLE)
 
 
 _INGEST_INIT_CACHE: Dict[Tuple, Any] = {}
@@ -1087,6 +1407,7 @@ class ScanEngine:
         host_update_fns: Optional[Dict[Any, Any]] = None,
         columns: Optional[Sequence[str]] = None,
         checkpointer: Optional[Any] = None,
+        slim_fetch: bool = False,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Run the shared pass. Returns (device states per scan analyzer,
         host accumulator states keyed as given).
@@ -1097,6 +1418,11 @@ class ScanEngine:
         restarts from the last checkpoint instead of batch 0 — the states
         fold identically (same batch boundaries, same batch indices), so
         the resumed result equals the uninterrupted one.
+
+        ``slim_fetch``: the caller asserts the fetched states feed ONLY
+        ``compute_metric_from`` (no persistence, no aggregation, no
+        checkpoint) — each analyzer's non-metric-bearing leaves then skip
+        the feed link and are reconstructed from identity values.
 
         Set ``DEEQU_TPU_PROFILE_DIR`` to capture a ``jax.profiler`` trace of
         every pass into that directory (SURVEY §5's optional profiler hook;
@@ -1115,7 +1441,7 @@ class ScanEngine:
         with tracer:
             return self._run_inner(
                 data, batch_size, host_accumulators, host_update_fns, columns,
-                checkpointer,
+                checkpointer, slim_fetch,
             )
 
     def _run_inner(
@@ -1126,9 +1452,10 @@ class ScanEngine:
         host_update_fns: Optional[Dict[Any, Any]] = None,
         columns: Optional[Sequence[str]] = None,
         checkpointer: Optional[Any] = None,
+        slim_fetch: bool = False,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         monitor = self.monitor
-        monitor.passes += 1
+        monitor.bump("passes")
         bs = effective_batch_size(data, batch_size)
         if self.mesh is not None:
             n_dev = self.mesh.devices.size
@@ -1162,10 +1489,14 @@ class ScanEngine:
                     "resuming ingest from checkpoint at batch %d",
                     resume.batch_index,
                 )
+        if ckpt is not None:
+            # checkpoints persist full states; a slim fetch would save
+            # identity-valued leaves into the resume point
+            slim_fetch = False
         if has_battery and self._resolve_placement() == "host":
             return self._run_host_tier(
                 data, bs, host_states, update_fns, columns,
-                checkpointer=ckpt, resume=resume,
+                checkpointer=ckpt, resume=resume, slim_fetch=slim_fetch,
             )
         if has_battery and self._update is None:
             # constructed under a host resolution but asked to run device
@@ -1175,6 +1506,14 @@ class ScanEngine:
         # materialize once, from unpack() after the last batch
         states: Tuple = ()
         cache_size_fn = getattr(self._update, "_cache_size", None)
+
+        def compiled_count() -> int:
+            try:
+                return cache_size_fn() if cache_size_fn is not None else 0
+            except Exception:  # noqa: BLE001
+                return 0
+
+        compiled_before = compiled_count()
 
         # pipelined pass: a single prefetch thread pulls batch i+1 and builds
         # its features while the (async-dispatched) device program chews on
@@ -1238,7 +1577,7 @@ class ScanEngine:
                     folded, bs, int(data.num_rows),
                     list(self.scan_analyzers), ck_states, host_states,
                 )
-                monitor.checkpoint_saves += 1
+                monitor.bump("checkpoint_saves")
 
         with ThreadPoolExecutor(max_workers=1) as pool:
             pending = pool.submit(produce)
@@ -1248,12 +1587,12 @@ class ScanEngine:
                     break
                 pending = pool.submit(produce)
                 batch, features = item
-                monitor.batches += 1
+                monitor.bump("batches")
                 if features is not None:
                     fault_point("device_update", tag=str(folded + 1))
                     with monitor.timed("device_dispatch"):
                         carry = self._update(carry, features)
-                    monitor.device_updates += 1
+                    monitor.bump("device_updates")
                 with monitor.timed("host_accumulators"):
                     for key, fn in update_fns.items():
                         host_states[key] = fn(host_states[key], batch)
@@ -1263,19 +1602,29 @@ class ScanEngine:
         if ckpt is not None:
             ckpt.complete()
         if carry is not None:
+            # drain the async dispatch queue UNDER the dispatch timer:
+            # device execution time belongs to device_dispatch, so the
+            # state_fetch phase measures the transfer alone (previously the
+            # blocking fetch absorbed all queued compute and the warm
+            # profile read as fetch-bound when it was not)
+            with monitor.timed("device_dispatch"):
+                jax.block_until_ready(jax.tree_util.tree_leaves(carry))
             states = self._update.unpack(carry)
-        if cache_size_fn is not None:
-            try:
-                monitor.jit_compiles = max(monitor.jit_compiles, cache_size_fn())
-            except Exception:  # noqa: BLE001
-                pass
+        compiled = compiled_count()
+        with _MONITOR_LOCK:
+            monitor.jit_compiles = max(monitor.jit_compiles, compiled)
+            monitor.program_compiles += max(0, compiled - compiled_before)
         with monitor.timed("state_fetch"):
-            host_side = _fetch_states_packed(states)
+            host_side = _fetch_states_packed(
+                states,
+                analyzers=tuple(self.scan_analyzers) if slim_fetch else None,
+            )
         return host_side, host_states
 
     def _run_host_tier(
         self, data, bs, host_states, update_fns, columns,
         checkpointer: Optional[Any] = None, resume: Optional[Any] = None,
+        slim_fetch: bool = False,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Host ingest tier: per-batch partial states next to the data, then
         chunked device folds of the stacked partials (+ one packed state
@@ -1317,6 +1666,13 @@ class ScanEngine:
                 ((b, n_real_b), _ingest_program(tuple(analyzers[i] for i in b)))
                 for b, n_real_b in bundles
             ]
+            try:
+                ingest_compiled_before = sum(
+                    p._cache_size()
+                    for p in {id(p): p for _, p in program}.values()
+                )
+            except Exception:  # noqa: BLE001
+                ingest_compiled_before = 0
             # identity states built ON DEVICE, one jit'd dispatch per bundle
             # (eager per-analyzer init_state cost one feed-link dispatch per
             # state LEAF — ~12s of a 300-analyzer cold profile)
@@ -1358,7 +1714,7 @@ class ScanEngine:
                 )
                 flags = np.zeros(len(group), dtype=bool)
                 flags[:n_real] = True
-                monitor.device_updates += 1
+                monitor.bump("device_updates")
                 if mesh is not None:
                     return sharded_ingest_fold(
                         analyzers, mesh, states, stacked, flags
@@ -1414,7 +1770,7 @@ class ScanEngine:
                     list(analyzers), _fetch_states_packed(tuple(states)),
                     host_states, host_batch_index=n,
                 )
-                monitor.checkpoint_saves += 1
+                monitor.bump("checkpoint_saves")
             progress["saved"] = progress["folded"]
 
         def drain_one(states):
@@ -1432,7 +1788,7 @@ class ScanEngine:
             ):
                 if index < start_batch:
                     continue  # already folded into the resumed states
-                monitor.batches += 1
+                monitor.bump("batches")
                 n += 1
                 pending.append(pool.submit(compute_partial, index, batch))
                 if index >= host_start:
@@ -1459,10 +1815,18 @@ class ScanEngine:
             states = fold_chunk(states, buffer, n_real=n_real)
         if program is not None:
             try:
-                monitor.jit_compiles = max(
-                    monitor.jit_compiles,
-                    max(prog._cache_size() for _, prog in program),
+                compiled = sum(
+                    p._cache_size()
+                    for p in {id(p): p for _, p in program}.values()
                 )
+                with _MONITOR_LOCK:
+                    monitor.jit_compiles = max(
+                        monitor.jit_compiles,
+                        max(prog._cache_size() for _, prog in program),
+                    )
+                    monitor.program_compiles += max(
+                        0, compiled - ingest_compiled_before
+                    )
             except Exception:  # noqa: BLE001
                 pass
         if mesh is not None:
@@ -1474,5 +1838,7 @@ class ScanEngine:
         if checkpointer is not None and mesh is None:
             checkpointer.complete()
         with monitor.timed("state_fetch"):
-            host_side = _fetch_states_packed(states)
+            host_side = _fetch_states_packed(
+                states, analyzers=analyzers if slim_fetch else None
+            )
         return host_side, host_states

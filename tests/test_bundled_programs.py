@@ -1,0 +1,236 @@
+"""Signature-bundled device scan programs: parity and sharing contracts.
+
+The bundled path (engine.BundledScanProgram) must be OBSERVATIONALLY
+IDENTICAL to the monolithic one-program-per-battery design it replaces
+(``DEEQU_TPU_SCAN_BUNDLE=0``): same metrics bit-for-bit, same states, on a
+single device and under the 8-virtual-device mesh the conftest forces.
+These tests pin that contract plus the slim-fetch protocol riding on it:
+
+- bundled vs monolithic metrics are bit-identical (the acceptance bar);
+- template-program reuse across columns is REAL (two batteries share one
+  PackedScanProgram object) and the remapped features compute the right
+  numbers, not the template column's;
+- the slim fetch returns metrics identical to the full fetch, while runs
+  that persist states still fetch FULL states (parity/ticks intact);
+- battery-level warmth introspection stays conservative: shared bundle
+  programs never make a never-dispatched battery read as warm.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Correlation,
+    DataType,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+@pytest.fixture
+def scan_data():
+    rng = np.random.default_rng(11)
+    n = 8192
+    x = rng.normal(size=n)
+    x[rng.random(n) < 0.07] = np.nan
+    return Dataset.from_dict(
+        {
+            "x": x,
+            "y": rng.normal(size=n),
+            "ints": rng.integers(0, 1000, n),
+            "s": np.array(
+                [["12", "ab", "3.5", "true", ""][i % 5] for i in range(n)],
+                dtype=object,
+            ),
+        }
+    )
+
+
+def mixed_battery():
+    return [
+        Size(),
+        Completeness("x"),
+        Mean("x"),
+        Sum("x"),
+        Minimum("x"),
+        Maximum("x"),
+        StandardDeviation("x"),
+        Mean("y"),
+        Sum("y"),
+        Correlation("x", "y"),
+        DataType("s"),
+        ApproxCountDistinct("ints"),
+        KLLSketch("x", KLLParameters(256, 0.64, 10)),
+    ]
+
+
+def run_metrics(data, battery, *, bundle: str, slim: str, batch_size=2048):
+    prior_bundle = os.environ.get("DEEQU_TPU_SCAN_BUNDLE")
+    prior_slim = os.environ.get("DEEQU_TPU_SLIM_FETCH")
+    os.environ["DEEQU_TPU_SCAN_BUNDLE"] = bundle
+    os.environ["DEEQU_TPU_SLIM_FETCH"] = slim
+    try:
+        return AnalysisRunner.do_analysis_run(
+            data, battery, batch_size=batch_size, placement="device"
+        )
+    finally:
+        for var, prior in (
+            ("DEEQU_TPU_SCAN_BUNDLE", prior_bundle),
+            ("DEEQU_TPU_SLIM_FETCH", prior_slim),
+        ):
+            if prior is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prior
+
+
+def assert_contexts_identical(ctx_a, ctx_b):
+    assert set(ctx_a.metric_map) == set(ctx_b.metric_map)
+    for a in ctx_a.metric_map:
+        va, vb = ctx_a.metric_map[a].value, ctx_b.metric_map[a].value
+        assert va.is_success == vb.is_success, a
+        if not va.is_success:
+            continue
+        ga, gb = va.get(), vb.get()
+        if isinstance(ga, float):
+            assert ga == gb or (np.isnan(ga) and np.isnan(gb)), (a, ga, gb)
+        elif hasattr(ga, "buckets"):  # KLL BucketDistribution
+            ba = [(b.low_value, b.high_value, b.count) for b in ga.buckets]
+            bb = [(b.low_value, b.high_value, b.count) for b in gb.buckets]
+            assert ba == bb, a
+        else:
+            assert str(ga) == str(gb), a
+
+
+class TestBundledVsMonolithicParity:
+    def test_metrics_bit_identical_single_device(self, scan_data):
+        battery = mixed_battery()
+        bundled = run_metrics(scan_data, battery, bundle="8", slim="1")
+        mono = run_metrics(scan_data, battery, bundle="0", slim="1")
+        assert_contexts_identical(bundled, mono)
+
+    def test_metrics_bit_identical_on_8_device_mesh(self, scan_data):
+        import jax
+
+        from deequ_tpu.parallel import make_mesh
+
+        assert len(jax.devices()) == 8  # the conftest's virtual-device mesh
+        mesh = make_mesh()
+        battery = mixed_battery()
+
+        def run(bundle):
+            prior = os.environ.get("DEEQU_TPU_SCAN_BUNDLE")
+            os.environ["DEEQU_TPU_SCAN_BUNDLE"] = bundle
+            try:
+                return AnalysisRunner.do_analysis_run(
+                    scan_data, battery, batch_size=2048, sharding=mesh,
+                    placement="device",
+                )
+            finally:
+                if prior is None:
+                    os.environ.pop("DEEQU_TPU_SCAN_BUNDLE", None)
+                else:
+                    os.environ["DEEQU_TPU_SCAN_BUNDLE"] = prior
+
+        assert_contexts_identical(run("8"), run("0"))
+
+    def test_slim_fetch_metrics_equal_full_fetch(self, scan_data):
+        battery = mixed_battery()
+        slim = run_metrics(scan_data, battery, bundle="8", slim="1")
+        full = run_metrics(scan_data, battery, bundle="8", slim="0")
+        assert_contexts_identical(slim, full)
+
+
+class TestProgramSharing:
+    def test_two_batteries_share_one_program_object(self):
+        from deequ_tpu.runners.engine import _fused_program
+
+        prog_a = _fused_program((Mean("share_col_a"),), None)
+        prog_b = _fused_program((Mean("share_col_b"),), None)
+        assert prog_a is not prog_b  # battery-level orchestrators differ
+        assert prog_a._programs[0] is prog_b._programs[0]  # compiled unit shared
+
+    def test_remapped_columns_compute_their_own_values(self):
+        # the shared template program must see each battery's OWN feature
+        # arrays: if remapping broke, col_b would get col_a's numbers
+        rng = np.random.default_rng(23)
+        a_vals = rng.normal(10, 1, 2048)
+        b_vals = rng.normal(-50, 5, 2048)
+        data = Dataset.from_dict({"remap_a": a_vals, "remap_b": b_vals})
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Mean("remap_a"), Mean("remap_b")], placement="device"
+        )
+        got_a = ctx.metric(Mean("remap_a")).value.get()
+        got_b = ctx.metric(Mean("remap_b")).value.get()
+        assert got_a == pytest.approx(a_vals.mean(), rel=1e-12)
+        assert got_b == pytest.approx(b_vals.mean(), rel=1e-12)
+
+    def test_shared_programs_do_not_fake_battery_warmth(self):
+        from deequ_tpu.runners.engine import (
+            _fused_program,
+            fused_program_is_cached,
+        )
+
+        warm_battery = (Mean("warmth_src_col"),)
+        data = Dataset.from_dict(
+            {"warmth_src_col": np.arange(128, dtype=np.float64)}
+        )
+        AnalysisRunner.do_analysis_run(
+            data, list(warm_battery), placement="device"
+        )
+        assert fused_program_is_cached(warm_battery)
+        # same signature, never dispatched: its bundle program is warm but
+        # the BATTERY must not read as warm (placement keys on batteries)
+        cold_battery = (Mean("warmth_never_ran_col"),)
+        _fused_program(cold_battery, None)
+        assert not fused_program_is_cached(cold_battery)
+
+
+class TestSlimFetchStateContract:
+    def test_persisting_runs_fetch_full_states(self, scan_data):
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        kll = KLLSketch("x", KLLParameters(256, 0.64, 10))
+        sp = InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(
+            scan_data, [kll], batch_size=2048, save_states_with=sp,
+            placement="device",
+        )
+        state = sp.load(kll)
+        # ticks drive future folds; the slim fetch drops them, so a
+        # persisted state carrying real ticks proves the run fetched full
+        assert int(np.asarray(state.ticks)) > 0
+        assert np.asarray(state.parity).shape == np.asarray(state.sizes).shape
+
+    def test_metric_leaves_contract_kll(self):
+        # the indices KLL declares metric-bearing must match the state's
+        # flatten order: items, sizes, count, g_min, g_max kept
+        import jax
+
+        kll = KLLSketch("contract_col", KLLParameters(64, 0.64, 5))
+        state = kll.init_state()
+        leaves = jax.tree_util.tree_leaves(state)
+        kept = kll.metric_leaves()
+        assert len(leaves) == 7
+        dropped = [j for j in range(7) if j not in set(kept)]
+        # dropped leaves are exactly parity (vector of level offsets) and
+        # ticks (scalar update counter)
+        shapes = [tuple(np.asarray(leaves[j]).shape) for j in dropped]
+        assert sorted(shapes) == sorted(
+            [tuple(np.asarray(state.parity).shape), ()]
+        )

@@ -1,0 +1,135 @@
+"""Exact-quantile mode (VERDICT r5 ask #9): ``relative_error=0.0``.
+
+The reference admits ``relativeError=0`` as exact Greenwald-Khanna mode
+(`analyzers/ApproxQuantiles.scala:30`); a KLL sketch cannot be exact in
+bounded memory, so here 0.0 routes the analyzer OFF the fused scan onto a
+host full-sort accumulator (`analyzers/sketches.py ExactQuantileState`)
+that still rides the single shared pass and matches ``numpy.quantile``
+bit-for-bit at O(n) host memory (the documented price of exactness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import ApproxQuantile, ApproxQuantiles, Mean
+from deequ_tpu.data import Dataset
+from deequ_tpu.exceptions import IllegalAnalyzerParameterException
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+@pytest.fixture
+def quantile_data():
+    rng = np.random.default_rng(5)
+    n = 40001  # odd count: the median interpolates between real values
+    vals = rng.normal(size=n) * 100
+    vals[rng.random(n) < 0.04] = np.nan
+    flags = rng.integers(0, 10, n)
+    return Dataset.from_dict({"v": vals, "flag": flags}), vals, flags
+
+
+class TestExactQuantile:
+    def test_matches_numpy_exactly(self, quantile_data):
+        data, vals, _ = quantile_data
+        for q in (0.0, 0.25, 0.5, 0.9, 1.0):
+            analyzer = ApproxQuantile("v", q, relative_error=0.0)
+            ctx = AnalysisRunner.do_analysis_run(data, [analyzer], batch_size=4096)
+            got = ctx.metric(analyzer).value.get()
+            want = float(np.nanquantile(vals, q))
+            assert got == want, (q, got, want)  # BIT-exact, not approx
+
+    def test_multiple_quantiles_exact(self, quantile_data):
+        data, vals, _ = quantile_data
+        analyzer = ApproxQuantiles("v", (0.1, 0.5, 0.99), relative_error=0.0)
+        ctx = AnalysisRunner.do_analysis_run(data, [analyzer], batch_size=4096)
+        got = ctx.metric(analyzer).value.get()
+        for q in (0.1, 0.5, 0.99):
+            assert got[str(q)] == float(np.nanquantile(vals, q))
+
+    def test_where_filter_exact(self, quantile_data):
+        data, vals, flags = quantile_data
+        analyzer = ApproxQuantile("v", 0.5, relative_error=0.0, where="flag < 5")
+        ctx = AnalysisRunner.do_analysis_run(data, [analyzer], batch_size=4096)
+        got = ctx.metric(analyzer).value.get()
+        want = float(np.nanquantile(vals[flags < 5], 0.5))
+        assert got == want
+
+    def test_shares_the_single_pass(self, quantile_data):
+        # exactness must not buy a second data pass: the accumulator folds
+        # through the same shared scan as every other analyzer
+        data, vals, _ = quantile_data
+        mon = RunMonitor()
+        ctx = AnalysisRunner.do_analysis_run(
+            data,
+            [ApproxQuantile("v", 0.5, relative_error=0.0), Mean("v")],
+            batch_size=4096,
+            monitor=mon,
+        )
+        assert mon.passes == 1
+        assert ctx.metric(Mean("v")).value.is_success
+        assert ctx.metric(
+            ApproxQuantile("v", 0.5, relative_error=0.0)
+        ).value.get() == float(np.nanquantile(vals, 0.5))
+
+    def test_empty_after_filter_is_empty_metric(self):
+        data = Dataset.from_dict({"v": [1.0, 2.0], "flag": [1, 1]})
+        analyzer = ApproxQuantile("v", 0.5, relative_error=0.0, where="flag > 5")
+        ctx = AnalysisRunner.do_analysis_run(data, [analyzer])
+        assert not ctx.metric(analyzer).value.is_success
+
+    def test_aggregated_states_merge_by_concatenation(self):
+        from deequ_tpu.analyzers.sketches import ExactQuantileState
+
+        a = ExactQuantileState().add(np.array([1.0, 5.0]))
+        b = ExactQuantileState().add(np.array([2.0, 9.0, 3.0]))
+        merged = a.merge(b)
+        assert merged.count == 5
+        assert float(np.quantile(merged.values(), 0.5)) == 3.0
+
+    def test_checkpointer_is_dropped_not_blown(self, quantile_data):
+        # ExactQuantileState is deliberately unregistered for persistence;
+        # a configured checkpointer must degrade to "no checkpoints" with a
+        # warning (the mesh precedent), never raise mid-save or silently
+        # lose the whole battery to bisection
+        data, vals, _ = quantile_data
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+        from deequ_tpu.reliability import IngestCheckpointer
+
+        ck = IngestCheckpointer(InMemoryStateProvider(), every=1)
+        mon = RunMonitor()
+        ctx = AnalysisRunner.do_analysis_run(
+            data,
+            [ApproxQuantile("v", 0.5, relative_error=0.0), Mean("v")],
+            batch_size=4096,
+            checkpointer=ck,
+            monitor=mon,
+        )
+        assert mon.checkpoint_saves == 0  # dropped, not attempted
+        assert mon.isolation_reruns == 0  # and nothing degraded
+        assert ctx.metric(
+            ApproxQuantile("v", 0.5, relative_error=0.0)
+        ).value.get() == float(np.nanquantile(vals, 0.5))
+        assert ctx.metric(Mean("v")).value.is_success
+
+    def test_negative_relative_error_still_rejected(self):
+        data = Dataset.from_dict({"v": [1.0, 2.0]})
+        analyzer = ApproxQuantile("v", 0.5, relative_error=-0.1)
+        ctx = AnalysisRunner.do_analysis_run(data, [analyzer])
+        value = ctx.metric(analyzer).value
+        assert not value.is_success
+        assert isinstance(value.exception, IllegalAnalyzerParameterException)
+        assert "interval [0, 1]" in str(value.exception)
+
+    def test_nonzero_error_stays_kll_backed(self, quantile_data):
+        # relative_error > 0 must keep riding the fused device scan: no
+        # host accumulator, bounded memory, approximate answer near truth
+        data, vals, _ = quantile_data
+        analyzer = ApproxQuantile("v", 0.5, relative_error=0.01)
+        assert not analyzer.host_exclusive
+        ctx = AnalysisRunner.do_analysis_run(data, [analyzer], batch_size=4096)
+        got = ctx.metric(analyzer).value.get()
+        want = float(np.nanquantile(vals, 0.5))
+        # rank error 0.01 over ~40k values: generous value-space envelope
+        assert abs(got - want) < 10.0

@@ -1,0 +1,242 @@
+"""Ports of two named reference test suites (VERDICT r5 ask #6).
+
+- ``KLLProfileTest.scala`` (reference `src/test/scala/com/amazon/deequ/KLL/
+  KLLProfileTest.scala`): column profiling with KLL sketches — default and
+  custom parameters, bucket structure, end-anchored bounds, exact bucket
+  counts on known data, and KLL absence on non-numeric columns.
+- ``MetricsRepositoryMultipleResultsLoaderTest.scala`` (reference
+  `src/test/scala/com/amazon/deequ/repository/
+  MetricsRepositoryMultipleResultsLoaderTest.scala`): the multi-result
+  query loader's filter combinations — tag values, analyzer subsets,
+  after/before time windows, their compositions, and the
+  DataFrame/JSON success-metric projections with tag columns.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    KLLParameters,
+    Size,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.profiles import ColumnProfiler, NumericColumnProfile
+from deequ_tpu.repository import (
+    AnalysisResult,
+    InMemoryMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.runners import AnalysisRunner
+
+
+# ---------------------------------------------------------------------------
+# KLLProfileTest.scala analog
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def kll_profile_data():
+    # 1..100 complete + a column with nulls + a plain string column
+    vals = np.arange(1, 101, dtype=np.float64)
+    with_nulls = vals.copy()
+    import pyarrow as pa
+
+    table = pa.table(
+        {
+            "att1": pa.array(vals),
+            "att2": pa.array(with_nulls, mask=np.arange(100) % 4 == 0),
+            "att3": pa.array([f"s{i % 7}" for i in range(100)]),
+        }
+    )
+    return Dataset.from_arrow(table)
+
+
+class TestKLLProfile:
+    """`KLLProfileTest.scala` — "basic profile with KLL" scenarios."""
+
+    def test_default_profile_attaches_kll_to_numeric_columns(self, kll_profile_data):
+        profiles = ColumnProfiler.profile(kll_profile_data)
+        p = profiles["att1"]
+        assert isinstance(p, NumericColumnProfile)
+        assert p.kll is not None
+        assert p.approx_percentiles  # non-empty, sorted
+        assert p.approx_percentiles == sorted(p.approx_percentiles)
+
+    def test_custom_parameters_are_recorded_and_honored(self, kll_profile_data):
+        params = KLLParameters(
+            sketch_size=512, shrinking_factor=0.64, number_of_buckets=10
+        )
+        profiles = ColumnProfiler.profile(
+            kll_profile_data, kll_parameters=params
+        )
+        kll = profiles["att1"].kll
+        assert len(kll.buckets) == 10
+        # parameters ride the distribution as [shrinkingFactor, sketchSize]
+        # (reference KLLProfileTest asserts the same pair)
+        assert kll.parameters == [0.64, 512.0]
+
+    def test_bucket_bounds_anchor_at_global_min_max(self, kll_profile_data):
+        params = KLLParameters(2048, 0.64, 4)
+        profiles = ColumnProfiler.profile(
+            kll_profile_data, kll_parameters=params
+        )
+        kll = profiles["att1"].kll
+        assert kll.buckets[0].low_value == 1.0
+        assert kll.buckets[-1].high_value == 100.0
+
+    def test_exact_bucket_counts_on_uniform_data(self, kll_profile_data):
+        # 100 distinct values 1..100, sketch far larger than the data: the
+        # sketch is lossless, so 2 equi-width buckets split exactly 50/50
+        # and telescope to the exact row count
+        params = KLLParameters(2048, 0.64, 2)
+        profiles = ColumnProfiler.profile(
+            kll_profile_data, kll_parameters=params
+        )
+        kll = profiles["att1"].kll
+        counts = [b.count for b in kll.buckets]
+        assert sum(counts) == 100
+        assert counts == [50, 50]
+
+    def test_null_values_are_excluded_from_the_sketch(self, kll_profile_data):
+        params = KLLParameters(2048, 0.64, 2)
+        profiles = ColumnProfiler.profile(
+            kll_profile_data, kll_parameters=params
+        )
+        kll = profiles["att2"].kll
+        assert kll is not None
+        assert sum(b.count for b in kll.buckets) == 75  # 25 of 100 are null
+
+    def test_string_column_has_no_kll(self, kll_profile_data):
+        profiles = ColumnProfiler.profile(kll_profile_data)
+        assert not isinstance(profiles["att3"], NumericColumnProfile)
+
+    def test_restricted_columns_only_profile_kll_where_asked(self, kll_profile_data):
+        profiles = ColumnProfiler.profile(
+            kll_profile_data, restrict_to_columns=["att1"]
+        )
+        assert set(profiles.profiles) == {"att1"}
+        assert profiles["att1"].kll is not None
+
+
+# ---------------------------------------------------------------------------
+# MetricsRepositoryMultipleResultsLoaderTest.scala analog
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def filled_repository():
+    """Two datasets' results under distinct tags and timestamps
+    (reference fixture: two DataFrames saved under `DataSet -> train/test`
+    tags at different dateTimes)."""
+    data_train = Dataset.from_dict(
+        {"item": ["1", "2", "3", "4"], "att1": ["a", "b", None, "d"]}
+    )
+    data_test = Dataset.from_dict(
+        {"item": ["5", "6", "7", "8", "9"], "att1": ["x", None, None, "y", "z"]}
+    )
+    repo = InMemoryMetricsRepository()
+    analyzers = [Size(), Completeness("att1"), ApproxCountDistinct("item")]
+    key_train = ResultKey(1000, {"dataset": "train", "region": "eu"})
+    key_test = ResultKey(2000, {"dataset": "test", "region": "eu"})
+    for data, key in ((data_train, key_train), (data_test, key_test)):
+        AnalysisRunner.do_analysis_run(
+            data,
+            analyzers,
+            metrics_repository=repo,
+            save_or_append_results_with_key=key,
+        )
+    return repo, key_train, key_test
+
+
+class TestMetricsRepositoryMultipleResultsLoader:
+    """`MetricsRepositoryMultipleResultsLoaderTest.scala` filter combos."""
+
+    def test_get_all_results(self, filled_repository):
+        repo, key_train, key_test = filled_repository
+        results = repo.load().get()
+        assert {r.result_key for r in results} == {key_train, key_test}
+        for r in results:
+            assert isinstance(r, AnalysisResult)
+            assert r.analyzer_context.metric(Size()).value.is_success
+
+    def test_filter_by_tag_values(self, filled_repository):
+        repo, key_train, _ = filled_repository
+        results = repo.load().with_tag_values({"dataset": "train"}).get()
+        assert [r.result_key for r in results] == [key_train]
+        # a shared tag matches both; an absent tag value matches none
+        assert len(repo.load().with_tag_values({"region": "eu"}).get()) == 2
+        assert repo.load().with_tag_values({"dataset": "holdout"}).get() == []
+
+    def test_filter_for_analyzers(self, filled_repository):
+        repo, _, _ = filled_repository
+        results = repo.load().for_analyzers([Size()]).get()
+        assert len(results) == 2
+        for r in results:
+            assert set(r.analyzer_context.metric_map) == {Size()}
+
+    def test_after_and_before_time_windows(self, filled_repository):
+        repo, key_train, key_test = filled_repository
+        assert [
+            r.result_key for r in repo.load().after(1500).get()
+        ] == [key_test]
+        assert [
+            r.result_key for r in repo.load().before(1500).get()
+        ] == [key_train]
+        # bounds are inclusive (reference: getAllResults with after =
+        # exact dateTime still returns that result)
+        assert len(repo.load().after(1000).get()) == 2
+        assert len(repo.load().before(2000).get()) == 2
+        # combined window isolating nothing
+        assert repo.load().after(1200).before(1800).get() == []
+
+    def test_combined_tag_analyzer_time_filters(self, filled_repository):
+        repo, _, key_test = filled_repository
+        results = (
+            repo.load()
+            .after(1500)
+            .with_tag_values({"dataset": "test"})
+            .for_analyzers([Completeness("att1")])
+            .get()
+        )
+        assert [r.result_key for r in results] == [key_test]
+        (context,) = [r.analyzer_context for r in results]
+        assert set(context.metric_map) == {Completeness("att1")}
+        assert context.metric(Completeness("att1")).value.get() == pytest.approx(
+            3 / 5
+        )
+
+    def test_success_metrics_as_records_with_tag_columns(self, filled_repository):
+        repo, _, _ = filled_repository
+        records = repo.load().get_success_metrics_as_records(
+            with_tags=["dataset"]
+        )
+        assert {r["dataset"] for r in records} == {"train", "test"}
+        size_rows = [r for r in records if r["name"] == "Size"]
+        assert {r["value"] for r in size_rows} == {4.0, 5.0}
+        for r in records:
+            assert {"entity", "instance", "name", "value", "dataset_date"} <= set(r)
+
+    def test_success_metrics_as_json_round_trips(self, filled_repository):
+        repo, _, _ = filled_repository
+        payload = json.loads(
+            repo.load()
+            .with_tag_values({"dataset": "train"})
+            .get_success_metrics_as_json(with_tags=["dataset", "region"])
+        )
+        assert all(row["dataset"] == "train" for row in payload)
+        assert all(row["region"] == "eu" for row in payload)
+        assert {row["name"] for row in payload} == {
+            "Size", "Completeness", "ApproxCountDistinct",
+        }
+
+    def test_data_frame_projection(self, filled_repository):
+        repo, _, _ = filled_repository
+        df = repo.load().get_success_metrics_as_data_frame(with_tags=["dataset"])
+        assert set(df.columns) >= {"entity", "instance", "name", "value", "dataset"}
+        assert len(df) == 6  # 3 analyzers x 2 results
