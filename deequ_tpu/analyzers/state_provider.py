@@ -35,6 +35,10 @@ from .grouping import FrequenciesAndNumRows
 #: property, `StateProvider.scala:187-241`). v1 .npz blobs still load:
 #: their leaf order is identical and their structure derives from the
 #: requesting analyzer, ignoring the legacy .pkl sidecar entirely.
+#: v2 blobs additionally carry an OPTIONAL ``__checksum__`` member (an
+#: xxhash64 content checksum verified on load; see `deequ_tpu.integrity`)
+#: — optional members older readers ignore do not bump the version, and
+#: legacy unchecksummed v2 blobs still load with a warn-once.
 STATE_FORMAT_VERSION = 2
 
 
@@ -94,6 +98,34 @@ def _check_state_version(found: int, kind: str) -> None:
         from ..exceptions import UnsupportedFormatVersionError
 
         raise UnsupportedFormatVersionError(kind, found, STATE_FORMAT_VERSION)
+
+
+def _warn_once_unchecksummed(kind: str, source: str) -> None:
+    from ..integrity import warn_once_unchecksummed
+
+    warn_once_unchecksummed(kind, source)
+
+
+def _blob_checksum(type_name: str, static: Dict[str, Any], leaves: list) -> str:
+    """Content checksum of a v2 .npz state blob: the state-type name, the
+    canonical static-field JSON and every leaf's dtype/shape/bytes. Computed
+    from the SAME numpy arrays that np.savez writes (and that np.load hands
+    back — savez round-trips arrays exactly), so persist and load hash
+    identical payloads unless the bytes on disk changed underneath."""
+    import json as _json
+
+    from ..integrity import checksum_bytes
+
+    parts = [
+        type_name.encode("utf-8"),
+        _json.dumps(static, sort_keys=True).encode("utf-8"),
+    ]
+    for leaf in leaves:
+        arr = np.ascontiguousarray(leaf)
+        parts.append(str(arr.dtype).encode("utf-8"))
+        parts.append(str(arr.shape).encode("utf-8"))
+        parts.append(arr.tobytes())
+    return checksum_bytes(b"\x1f".join(parts))
 
 
 def _sanitize_namespace_part(part: str) -> str:
@@ -206,7 +238,12 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
         base = dio.join(self.path, self._key(analyzer))
         if isinstance(state, FrequenciesAndNumRows):
+            import io as _io
+
             import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            from ..integrity import checksum_bytes
 
             # name index levels after the group columns: value_counts-built
             # series (Histogram) have unnamed indexes that would otherwise
@@ -216,16 +253,23 @@ class FileSystemStateProvider(StateLoader, StatePersister):
                 .rename_axis(state.group_columns)
                 .reset_index()
             )
-            dio.write_parquet_table(
-                pa.Table.from_pandas(frame, preserve_index=False),
-                base + "-frequencies.parquet",
+            # serialize to a buffer first so the checksum covers the EXACT
+            # file bytes: any later flip — data page, footer, magic — fails
+            # verification on load
+            sink = _io.BytesIO()
+            pq.write_table(
+                pa.Table.from_pandas(frame, preserve_index=False), sink
             )
+            payload = sink.getvalue()
+            with dio.open_file(base + "-frequencies.parquet", "wb") as fh:
+                fh.write(payload)
             with dio.open_file(base + "-meta.json", "w") as fh:
                 json.dump(
                     {
                         "formatVersion": STATE_FORMAT_VERSION,
                         "num_rows": state.num_rows,
                         "group_columns": state.group_columns,
+                        "checksum": checksum_bytes(payload),
                     },
                     fh,
                 )
@@ -244,28 +288,63 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             )
         _, static_fields = _split_fields(type(state))
         static = {name: getattr(state, name) for name in static_fields}
+        host_leaves = [np.asarray(v) for v in leaves]
         with dio.open_file(base + "-state.npz", "wb") as fh:
             np.savez(
                 fh,
                 __format_version__=np.int64(STATE_FORMAT_VERSION),
                 __state_type__=np.str_(type_name),
                 __static__=np.str_(json.dumps(static)),
-                **{f"leaf{i}": np.asarray(v) for i, v in enumerate(leaves)},
+                __checksum__=np.str_(
+                    _blob_checksum(type_name, static, host_leaves)
+                ),
+                **{f"leaf{i}": v for i, v in enumerate(host_leaves)},
             )
 
     def load(self, analyzer: Analyzer) -> Optional[Any]:
         from .. import io as dio
+        from ..exceptions import CorruptStateError
+        from ..reliability.faults import fault_point
 
         base = dio.join(self.path, self._key(analyzer))
+        # chaos site: an injected "corrupt" fault here stands in for a blob
+        # whose bytes rotted after the existence check
+        fault_point("state_load", tag=repr(analyzer))
         if dio.exists(base + "-frequencies.parquet"):
-            frame = dio.read_parquet_table(base + "-frequencies.parquet").to_pandas()
-            with dio.open_file(base + "-meta.json", "r") as fh:
-                meta = json.load(fh)
+            import io as _io
+
+            import pyarrow.parquet as pq
+
+            from ..integrity import verify_checksum
+
+            source = base + "-frequencies.parquet"
+            with dio.open_file(source, "rb") as fh:
+                payload = fh.read()
+            try:
+                with dio.open_file(base + "-meta.json", "r") as fh:
+                    meta = json.load(fh)
+            except ValueError as exc:
+                raise CorruptStateError(
+                    "frequency-state sidecar", base + "-meta.json", str(exc)
+                ) from exc
             # sidecars from before versioning (round <=3) carry no marker
             # and ARE the v1 layout
             _check_state_version(
                 int(meta.get("formatVersion", 1)), "frequency-state sidecar"
             )
+            if "checksum" in meta:
+                verify_checksum(
+                    payload, meta["checksum"], "frequency-state parquet",
+                    source,
+                )
+            else:
+                _warn_once_unchecksummed("frequency-state parquet", source)
+            try:
+                frame = pq.read_table(_io.BytesIO(payload)).to_pandas()
+            except Exception as exc:  # noqa: BLE001 - unparseable = corrupt
+                raise CorruptStateError(
+                    "frequency-state parquet", source, str(exc)
+                ) from exc
             import pandas as pd
 
             cols = meta["group_columns"]
@@ -280,19 +359,63 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
             import jax
 
-            with dio.open_file(base + "-state.npz", "rb") as fh:
-                data = np.load(_io.BytesIO(fh.read()))
-            if "__format_version__" in data.files:
-                _check_state_version(int(data["__format_version__"]), ".npz state blob")
-            n_leaves = sum(1 for f in data.files if f.startswith("leaf"))
-            leaves = [data[f"leaf{i}"] for i in range(n_leaves)]
-            if "__state_type__" in data.files:
-                # v2: reconstruct via the static registry
-                return _reconstruct_state(
-                    str(data["__state_type__"]),
-                    json.loads(str(data["__static__"])),
-                    leaves,
+            source = base + "-state.npz"
+            with dio.open_file(source, "rb") as fh:
+                raw = fh.read()
+            # np.load is LAZY: member bytes decode (and zip CRCs fire) on
+            # access, so every member read lives inside the corruption
+            # guard — a torn zip anywhere surfaces as the one typed error
+            try:
+                data = np.load(_io.BytesIO(raw))
+                files = set(data.files)
+                version = (
+                    int(data["__format_version__"])
+                    if "__format_version__" in files
+                    else None
                 )
+                n_leaves = sum(1 for f in files if f.startswith("leaf"))
+                leaves = [data[f"leaf{i}"] for i in range(n_leaves)]
+                type_name = (
+                    str(data["__state_type__"])
+                    if "__state_type__" in files
+                    else None
+                )
+                static_raw = str(data["__static__"]) if type_name else "{}"
+                stored = (
+                    str(data["__checksum__"]) if "__checksum__" in files
+                    else None
+                )
+            except Exception as exc:  # noqa: BLE001 - torn zip = corrupt
+                raise CorruptStateError(".npz state blob", source, str(exc)) from exc
+            if version is not None:
+                _check_state_version(version, ".npz state blob")
+            if type_name is not None:
+                # v2: reconstruct via the static registry
+                try:
+                    static = json.loads(static_raw)
+                except ValueError as exc:
+                    raise CorruptStateError(
+                        ".npz state blob", source, str(exc)
+                    ) from exc
+                if stored is not None:
+                    actual = _blob_checksum(type_name, static, leaves)
+                    if actual != stored:
+                        raise CorruptStateError(
+                            ".npz state blob", source,
+                            f"checksum mismatch (stored {stored}, "
+                            f"computed {actual})",
+                        )
+                else:
+                    _warn_once_unchecksummed(".npz state blob", source)
+                try:
+                    return _reconstruct_state(type_name, static, leaves)
+                except ValueError as exc:
+                    # leaf-count / static-field mismatches are the torn-blob
+                    # signature; surface them under the one typed error the
+                    # recovery layers key on
+                    raise CorruptStateError(
+                        ".npz state blob", source, str(exc)
+                    ) from exc
             # v1 blob: same leaf order, but the structure rode a pickle
             # sidecar. Never unpickle it — the requesting analyzer's own
             # state structure (class + static fields) is authoritative and

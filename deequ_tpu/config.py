@@ -75,3 +75,17 @@ SLIM_FETCH_ENV = "DEEQU_TPU_SLIM_FETCH"
 
 def slim_fetch_enabled() -> bool:
     return os.environ.get(SLIM_FETCH_ENV, "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
+# knob is documented here with the other operator-facing switches)
+# ---------------------------------------------------------------------------
+
+#: env var: per-pass watchdog deadline in seconds. Unset = derive from the
+#: measured per-batch rate of completed passes on the same tier (a 10x
+#: multiple with a 30s floor; disabled until a first rate exists). Any
+#: value <= 0 disables the watchdog. A pass exceeding its deadline is
+#: cancelled with a typed ScanStallError and fails over to the other tier
+#: exactly like a thrown device fault.
+SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"

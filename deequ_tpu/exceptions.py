@@ -78,6 +78,62 @@ class AnalyzerFaultException(MetricCalculationRuntimeException):
     completes the rest."""
 
 
+class CorruptStateError(MetricCalculationRuntimeException, ValueError):
+    """A persisted payload (state blob, repository entry, checkpoint) failed
+    its integrity check: the stored xxhash64 content checksum does not match
+    the bytes on disk, or the payload is structurally torn. The data plane
+    treats this as RECOVERABLE, never fatal: corrupt checkpoints fall back
+    to a fresh fold (the resume point is lost, the results are not), corrupt
+    repository entries are quarantined to a ``.quarantine/`` sidecar instead
+    of poisoning query loaders, and corrupt state blobs degrade exactly the
+    analyzers that needed them to typed ``Failure`` metrics. The reference
+    assumes torn/garbled state rather than hoping against it — its per-type
+    binary codecs pin byte layouts precisely (`StateProvider.scala:187-311`);
+    the checksum is our equivalent tripwire."""
+
+    def __init__(self, kind: str, source: str, detail: str = ""):
+        self.kind = kind
+        self.source = source
+        super().__init__(
+            f"corrupt {kind} at {source}"
+            + (f": {detail}" if detail else "")
+        )
+
+
+class SchemaDriftError(MetricCalculationRuntimeException):
+    """A streaming micro-batch's schema drifted from the session's
+    :class:`~deequ_tpu.service.drift.SchemaContract` (column added/dropped/
+    retyped beyond a compatible widening). Raised BEFORE the batch folds,
+    so persisted algebraic states are never contaminated by mixed-schema
+    merges. Carries the structured drift list for operator triage."""
+
+    def __init__(self, session: str, drifts):
+        self.session = session
+        self.drifts = list(drifts)
+        super().__init__(
+            f"schema drift in session {session}: " + "; ".join(self.drifts)
+        )
+
+
+class ScanStallError(DeviceFailureException):
+    """A device or host-tier pass exceeded its watchdog deadline without
+    finishing OR failing — the hang-not-crash failure mode the exception-
+    driven reliability layer cannot see. Deliberately a
+    ``DeviceFailureException`` subclass: ``classify_failure`` then maps it
+    to the tier-failover path (the battery re-runs on the other tier with
+    fresh states) and the service's placement router puts the battery on
+    probation, exactly like a thrown device fault."""
+
+    def __init__(self, site: str, deadline_s: float, waited_s: float):
+        self.site = site
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+        super().__init__(
+            f"scan watchdog: {site} pass exceeded its {deadline_s:.1f}s "
+            f"deadline (waited {waited_s:.1f}s); cancelling and failing over"
+        )
+
+
 class UnsupportedFormatVersionError(Exception):
     """A persisted payload (metrics-history JSON or .npz state blob) carries
     a format version this build does not understand. Raised INSTEAD of

@@ -1,0 +1,129 @@
+"""Content checksums for the data plane's persisted payloads.
+
+Every durable artifact this engine writes — v2 state blobs, FS repository
+entries, ingest-checkpoint meta records — carries an xxhash64 content
+checksum (the same hash the HLL registers already use, `ops/hashing.py`),
+verified on load. The threat model is NOT an adversary (the state registry
+already refuses code execution on load); it is the mundane reality of
+long-lived storage under a service that runs for weeks: torn writes,
+bit rot, partial uploads, concurrent writers on eventually-consistent
+stores. A mismatch raises a typed
+:class:`~deequ_tpu.exceptions.CorruptStateError` that every consumer
+treats as recoverable (quarantine / fall back / degrade), never as a
+crash — the reference pins its state serde byte layouts for the same
+reason (`StateProvider.scala:187-311`): garbled state is assumed, not
+hypothetical.
+
+Checksums are hex strings (16 lowercase hex chars of the 64-bit digest) so
+they embed in JSON and .npz string fields without byte-order concerns.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from .exceptions import CorruptStateError
+from .ops.hashing import xxhash64_bytes, xxhash64_u64
+
+#: seed distinguishing integrity checksums from the HLL row-hash domain —
+#: a payload that happens to contain row hashes can never alias its own
+#: checksum
+CHECKSUM_SEED = 0x5EED
+
+#: payloads below this size hash through the canonical scalar xxhash64
+#: (cheap at this scale); above it, the vectorized block checksum applies
+_VECTOR_THRESHOLD = 1 << 10
+
+#: position-tag multiplier for the block checksum (xxhash64's own prime 1)
+_POS_PRIME = np.uint64(11400714785074694791)
+
+
+#: warn-once latches per blob family: a store written by a pre-checksum
+#: build floods neither the log nor the operator — one line per process
+#: per family, then silence
+_LEGACY_WARNED: Dict[str, bool] = {}
+
+
+def warn_once_unchecksummed(kind: str, source: str) -> None:
+    """Log (once per process per ``kind``) that a legacy artifact without a
+    content checksum was loaded unverified."""
+    import logging
+
+    if not _LEGACY_WARNED.get(kind):
+        _LEGACY_WARNED[kind] = True
+        logging.getLogger(__name__).warning(
+            "loading legacy %s without a content checksum (first seen: %s); "
+            "integrity verification is skipped for unchecksummed payloads — "
+            "re-persist to upgrade them",
+            kind, source,
+        )
+
+
+def checksum_bytes(payload: bytes) -> str:
+    """Content checksum of raw bytes, as 16 hex chars.
+
+    Small payloads (< 1 KiB: meta records, repository entries) use the
+    canonical scalar xxhash64. Large payloads (state blobs — KLL item
+    buffers run to megabytes) use a VECTORIZED construction over the same
+    primitive: the payload's little-endian u64 words are position-tagged
+    (``word ^ index*prime`` — so transposed regions change the digest),
+    hashed per-word with the numpy ``xxhash64_u64`` kernel, XOR-combined,
+    and finalized with a scalar xxhash64 over (combined, byte tail,
+    length). The pure-Python byte-stream loop measures ~10 MB/s — it would
+    cost more than the persist it protects — while the block construction
+    runs at memory bandwidth; its collision behavior is equivalent for the
+    bit-rot/torn-write faults this layer exists to catch. The digest
+    definition is internal (both sides of every verify call this one
+    function) and pinned by tests."""
+    n = len(payload)
+    if n < _VECTOR_THRESHOLD:
+        return f"{xxhash64_bytes(payload, CHECKSUM_SEED):016x}"
+    words = np.frombuffer(payload, dtype="<u8", count=n // 8)
+    with np.errstate(over="ignore"):
+        tagged = words ^ (
+            np.arange(words.size, dtype=np.uint64) * _POS_PRIME
+        )
+        combined = np.bitwise_xor.reduce(xxhash64_u64(tagged, CHECKSUM_SEED))
+    tail = payload[(n // 8) * 8:]
+    final = xxhash64_bytes(
+        int(combined).to_bytes(8, "little") + tail + n.to_bytes(8, "little"),
+        CHECKSUM_SEED,
+    )
+    return f"{final:016x}"
+
+
+def checksum_json(obj: Dict[str, Any]) -> str:
+    """Checksum of a JSON-able dict under a CANONICAL encoding (sorted
+    keys, no whitespace) so semantically-equal payloads always hash alike
+    regardless of who serialized them."""
+    return checksum_bytes(
+        json.dumps(obj, sort_keys=True, separators=(",", ":")).encode("utf-8")
+    )
+
+
+def verify_checksum(
+    payload: bytes, expected: str, kind: str, source: str
+) -> None:
+    """Raise :class:`CorruptStateError` unless ``payload`` hashes to
+    ``expected``. ``kind``/``source`` feed the error's operator-facing
+    identity ("what artifact, where")."""
+    actual = checksum_bytes(payload)
+    if actual != str(expected):
+        raise CorruptStateError(
+            kind, source,
+            f"checksum mismatch (stored {expected}, computed {actual})",
+        )
+
+
+def verify_json_checksum(
+    obj: Dict[str, Any], expected: str, kind: str, source: str
+) -> None:
+    actual = checksum_json(obj)
+    if actual != str(expected):
+        raise CorruptStateError(
+            kind, source,
+            f"checksum mismatch (stored {expected}, computed {actual})",
+        )

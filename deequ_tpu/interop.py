@@ -1,0 +1,83 @@
+"""JVM interop: readers/writers for the reference's persisted state blobs.
+
+The reference persists each state through a fixed per-type binary codec on
+a JVM ``DataOutputStream`` (`analyzers/StateProvider.scala:187-311`) —
+big-endian, no framing beyond the type's own fields. Reading those blobs
+directly lets a jax_graft deployment take over (or run shadow to) an
+existing JVM deequ pipeline without re-scanning history: day-partition
+states written by Spark merge straight into our engine's semigroup states.
+
+First leg: the ApproxCountDistinct (HLL++) state. The reference stores the
+sketch as a packed word array — 512 six-bit registers, 10 per 64-bit word,
+52 words (`StatefulHyperloglogPlus.scala`) — serialized as::
+
+    int32  (big-endian)  number of words
+    int64 * n (big-endian) the words
+
+(`StateProvider.scala` ``persistLongArrayState``/``loadLongArrayState``).
+Our engine keeps the registers UNPACKED (int32[512], device-friendly
+``maximum`` merges); `ops/hll.py`'s ``words_to_registers`` /
+``registers_to_words`` convert between the two layouts bit-exactly, so a
+round trip through the JVM blob format is lossless and the cardinality
+estimate is identical on both sides (same hash, same bias tables).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .exceptions import CorruptStateError
+from .ops.hll import M, NUM_WORDS, registers_to_words, words_to_registers
+
+#: bytes of a well-formed reference HLL blob: the int32 count + 52 longs
+JVM_HLL_BLOB_BYTES = 4 + 8 * NUM_WORDS
+
+
+def read_jvm_hll_state_blob(blob: bytes, source: str = "<bytes>"):
+    """Parse a reference ``ApproxCountDistinctState`` blob into a live
+    :class:`~deequ_tpu.analyzers.states.ApproxCountDistinctState`.
+
+    Raises :class:`CorruptStateError` on any structural violation (short
+    read, wrong word count) — a JVM blob has no checksum of its own, so
+    the fixed layout IS the integrity check."""
+    from .analyzers.states import ApproxCountDistinctState
+
+    if len(blob) < 4:
+        raise CorruptStateError(
+            "JVM HLL state blob", source,
+            f"{len(blob)} bytes is too short for the word-count header",
+        )
+    (n_words,) = struct.unpack_from(">i", blob, 0)
+    if n_words != NUM_WORDS:
+        raise CorruptStateError(
+            "JVM HLL state blob", source,
+            f"word count {n_words} != {NUM_WORDS} (p=9 layout)",
+        )
+    if len(blob) != 4 + 8 * n_words:
+        raise CorruptStateError(
+            "JVM HLL state blob", source,
+            f"{len(blob)} bytes != expected {4 + 8 * n_words}",
+        )
+    words = np.frombuffer(blob, dtype=">i8", count=n_words, offset=4)
+    registers = words_to_registers(words.astype(np.int64).view(np.uint64))
+    import jax.numpy as jnp
+
+    return ApproxCountDistinctState(jnp.asarray(registers, dtype=jnp.int32))
+
+
+def write_jvm_hll_state_blob(state) -> bytes:
+    """Serialize an ``ApproxCountDistinctState`` into the reference's blob
+    layout (the inverse of :func:`read_jvm_hll_state_blob`; exists so a
+    jax_graft deployment can hand states BACK to a JVM pipeline, and so
+    the round-trip tests need no checked-in binary fixture)."""
+    registers = np.asarray(state.registers, dtype=np.int32)
+    if registers.shape != (M,):
+        raise ValueError(
+            f"expected int32[{M}] registers, got shape {registers.shape}"
+        )
+    words = registers_to_words(registers)
+    return struct.pack(">i", NUM_WORDS) + words.view(np.int64).astype(
+        ">i8"
+    ).tobytes()

@@ -14,7 +14,11 @@ column's sketch overflowed. This package is the substrate:
 - :mod:`.checkpoint` — resumable multi-batch ingest: algebraic states
   checkpoint through the existing ``StatePersister`` every K batches, and
   an interrupted run resumes from the last checkpoint with results equal
-  to the uninterrupted run.
+  to the uninterrupted run;
+- :mod:`.watchdog` — deadline monitoring for device/host passes: a pass
+  that HANGS (rather than throws) is cancelled with a typed
+  ``ScanStallError`` and takes the same tier-failover + placement-
+  probation path as a thrown device fault.
 
 See README "Failure semantics" for the operator-facing contract.
 """
@@ -38,6 +42,13 @@ from .isolation import (
     classify_failure,
     run_scan_resilient,
 )
+from .watchdog import (
+    SCAN_DEADLINE_ENV,
+    RateTracker,
+    rate_tracker,
+    run_with_deadline,
+    scan_deadline_s,
+)
 
 __all__ = [
     "IngestCheckpointer", "ResumePoint", "battery_fingerprint",
@@ -45,4 +56,6 @@ __all__ = [
     "inject", "install", "clear", "fault_point", "active_injector",
     "FAULTS_ENV", "FAULT_SEED_ENV",
     "ResilientScanOutcome", "classify_failure", "run_scan_resilient",
+    "SCAN_DEADLINE_ENV", "RateTracker", "rate_tracker",
+    "run_with_deadline", "scan_deadline_s",
 ]

@@ -128,10 +128,43 @@ class IngestCheckpointer:
             )
         if int(every) < 1:
             raise ValueError(f"checkpoint interval must be >= 1, got {every}")
+        import threading
+
         self.provider = provider
         self.every = int(every)
         #: observability: (batch_index, n_states) per save, newest last
         self.saves: List[Tuple[int, int]] = []
+        #: resume points discarded because a meta record or state blob
+        #: failed its integrity check (each cost a fresh fold, never a crash)
+        self.corrupt_discards: int = 0
+        #: saves/completes refused because their pass was FENCED by a newer
+        #: one (see begin_run) — the watchdog-abandoned-zombie defense
+        self.fenced_saves: int = 0
+        #: serializes saves AND the epoch check: a stale pass that is
+        #: mid-save when a new pass begins finishes atomically before the
+        #: new pass's first save, so save sequences never interleave
+        self._save_lock = threading.Lock()
+        self._epoch = 0
+
+    def begin_run(self) -> int:
+        """Fence every earlier pass and return this pass's epoch token.
+
+        The scan watchdog CANCELS a stalled pass by abandoning its thread —
+        Python cannot kill it, so the zombie keeps folding and would keep
+        CHECKPOINTING concurrently with the failover re-run over the same
+        provider. Interleaved saves could splice a meta record from one
+        pass over state blobs from another: every per-blob checksum passes,
+        the fingerprint matches, and a resume would silently skip batches.
+        Epoch fencing closes this: each engine pass calls ``begin_run()``
+        before touching the store, and ``save``/``complete`` carrying a
+        stale epoch are refused under the save lock (counted in
+        ``fenced_saves``)."""
+        with self._save_lock:
+            self._epoch += 1
+            return self._epoch
+
+    def _current(self, epoch: Optional[int]) -> bool:
+        return epoch is None or epoch == self._epoch
 
     # -- meta ----------------------------------------------------------------
 
@@ -154,20 +187,54 @@ class IngestCheckpointer:
                 if dio.exists(path):
                     dio.write_text_atomic(path, json.dumps({"cleared": True}))
             else:
+                from ..integrity import checksum_json
+
+                # the meta record pins WHICH states form a resume point; a
+                # flipped byte in it (batch index, fingerprint) would splice
+                # wrong states into a resumed fold — checksum it like every
+                # other durable payload
+                meta = dict(meta)
+                meta["checksum"] = checksum_json(
+                    {k: v for k, v in meta.items() if k != "checksum"}
+                )
                 dio.write_text_atomic(path, json.dumps(meta))
             return
         self.provider.persist(self._META_SENTINEL, meta)
 
     def _read_meta(self) -> Optional[Dict[str, Any]]:
+        """The persisted meta record, or None. Raises
+        :class:`CorruptStateError` when the record exists but is torn or
+        fails its checksum — ``load`` turns that into a fresh-start
+        fallback, never a crash."""
         path = self._meta_path()
         if path is not None:
             from .. import io as dio
+            from ..exceptions import CorruptStateError
 
             if not dio.exists(path):
                 return None
             with dio.open_file(path, "r") as fh:
-                meta = json.load(fh)
-            return None if meta.get("cleared") else meta
+                raw = fh.read()
+            try:
+                meta = json.loads(raw)
+            except ValueError as exc:
+                raise CorruptStateError(
+                    "ingest-checkpoint meta", path, str(exc)
+                ) from exc
+            if meta.get("cleared"):
+                return None
+            if "checksum" in meta:
+                from ..integrity import verify_json_checksum
+
+                verify_json_checksum(
+                    {k: v for k, v in meta.items() if k != "checksum"},
+                    meta["checksum"], "ingest-checkpoint meta", path,
+                )
+            else:
+                from ..integrity import warn_once_unchecksummed
+
+                warn_once_unchecksummed("ingest-checkpoint meta", path)
+            return meta
         return self.provider.load(self._META_SENTINEL)
 
     # -- checkpoint lifecycle ------------------------------------------------
@@ -181,6 +248,7 @@ class IngestCheckpointer:
         scan_states: Sequence[Any],
         host_states: Dict[Any, Any],
         host_batch_index: Optional[int] = None,
+        epoch: Optional[int] = None,
     ) -> None:
         """Persist one checkpoint with an invalidate-first protocol: the
         meta record is CLEARED, then every state overwrites its slot, then
@@ -189,34 +257,47 @@ class IngestCheckpointer:
         paired with a mix of batch-K and batch-K' states — a resume would
         then silently double-fold batches K..K'. With the invalidation
         marker, a torn save costs the resume point (the next run starts
-        from batch 0) but can never corrupt results."""
+        from batch 0) but can never corrupt results.
+
+        ``epoch`` (from :meth:`begin_run`) fences stale passes: a save
+        carrying an epoch that is no longer current is refused whole —
+        see begin_run for why."""
         from .faults import fault_point
 
-        fault_point("checkpoint", tag=str(batch_index))
-        self._write_meta(None)  # invalidate: states are about to be torn
-        for analyzer, state in zip(scan_analyzers, scan_states):
-            self.provider.persist(analyzer, state)
-        for key, state in host_states.items():
-            # SNAPSHOT mutable accumulator states: the run keeps folding
-            # into the live object after this save, and an in-memory
-            # provider stores references — without the copy, the
-            # "checkpoint" would silently track the live state and a
-            # resume would double-fold every batch since the save
-            self.provider.persist(_host_key(key), _snapshot_state(state))
-        self._write_meta(
-            {
-                "batch_index": int(batch_index),
-                "batch_size": int(batch_size),
-                "num_rows": int(num_rows),
-                "host_batch_index": int(
-                    batch_index if host_batch_index is None else host_batch_index
-                ),
-                "fingerprint": battery_fingerprint(
-                    scan_analyzers, list(host_states)
-                ),
-            }
-        )
-        self.saves.append((int(batch_index), len(list(scan_analyzers))))
+        with self._save_lock:
+            if not self._current(epoch):
+                self.fenced_saves += 1
+                _logger.warning(
+                    "checkpoint save at batch %d refused: its pass was "
+                    "fenced by a newer one (watchdog-abandoned zombie?)",
+                    batch_index,
+                )
+                return
+            fault_point("checkpoint", tag=str(batch_index))
+            self._write_meta(None)  # invalidate: states are about to be torn
+            for analyzer, state in zip(scan_analyzers, scan_states):
+                self.provider.persist(analyzer, state)
+            for key, state in host_states.items():
+                # SNAPSHOT mutable accumulator states: the run keeps folding
+                # into the live object after this save, and an in-memory
+                # provider stores references — without the copy, the
+                # "checkpoint" would silently track the live state and a
+                # resume would double-fold every batch since the save
+                self.provider.persist(_host_key(key), _snapshot_state(state))
+            self._write_meta(
+                {
+                    "batch_index": int(batch_index),
+                    "batch_size": int(batch_size),
+                    "num_rows": int(num_rows),
+                    "host_batch_index": int(
+                        batch_index if host_batch_index is None else host_batch_index
+                    ),
+                    "fingerprint": battery_fingerprint(
+                        scan_analyzers, list(host_states)
+                    ),
+                }
+            )
+            self.saves.append((int(batch_index), len(list(scan_analyzers))))
 
     def load(
         self,
@@ -224,10 +305,32 @@ class IngestCheckpointer:
         num_rows: int,
         scan_analyzers: Sequence[Any],
         host_keys: Sequence[Any],
+        monitor: Optional[Any] = None,
     ) -> Optional[ResumePoint]:
         """The resume point for a run of this exact shape, or None (no
-        checkpoint / shape mismatch / any state missing)."""
-        meta = self._read_meta()
+        checkpoint / shape mismatch / any state missing / CORRUPT
+        checkpoint). Corruption — a torn meta record, a failed meta or
+        state-blob checksum — costs the resume point, never the run: the
+        fold restarts from batch 0 and recomputes bit-exactly, which is the
+        same outcome the invalidate-first save protocol already accepts for
+        a torn save. ``monitor`` (a RunMonitor), when given, counts the
+        discard under ``corrupt_quarantined``."""
+        from ..exceptions import CorruptStateError
+
+        def discard(what: str, exc: BaseException) -> None:
+            self.corrupt_discards += 1
+            if monitor is not None:
+                monitor.bump("corrupt_quarantined")
+            _logger.warning(
+                "ingest checkpoint discarded (%s is corrupt; restarting "
+                "the fold from batch 0): %s", what, exc,
+            )
+
+        try:
+            meta = self._read_meta()
+        except CorruptStateError as exc:
+            discard("meta record", exc)
+            return None
         if not meta:
             return None
         fingerprint = battery_fingerprint(scan_analyzers, host_keys)
@@ -242,12 +345,20 @@ class IngestCheckpointer:
                 meta, batch_size, num_rows, fingerprint,
             )
             return None
-        scan_states = [self.provider.load(a) for a in scan_analyzers]
+        try:
+            scan_states = [self.provider.load(a) for a in scan_analyzers]
+        except CorruptStateError as exc:
+            discard("a scan state blob", exc)
+            return None
         if any(s is None for s in scan_states):
             return None
         host_states = {}
         for key in host_keys:
-            state = self.provider.load(_host_key(key))
+            try:
+                state = self.provider.load(_host_key(key))
+            except CorruptStateError as exc:
+                discard("a host accumulator state blob", exc)
+                return None
             if state is None:
                 return None
             # snapshot on the way OUT too: the resumed run folds into this
@@ -260,7 +371,13 @@ class IngestCheckpointer:
             host_batch_index=int(meta.get("host_batch_index", batch_index)),
         )
 
-    def complete(self) -> None:
+    def complete(self, epoch: Optional[int] = None) -> None:
         """Mark the run finished: clears the meta so the NEXT run over this
-        provider starts fresh instead of resuming a done fold."""
-        self._write_meta(None)
+        provider starts fresh instead of resuming a done fold. A stale
+        (fenced) pass completing late must NOT clear the active pass's
+        resume point."""
+        with self._save_lock:
+            if not self._current(epoch):
+                self.fenced_saves += 1
+                return
+            self._write_meta(None)

@@ -33,7 +33,15 @@ site                 fires
 ``collective_merge`` before a collective state merge dispatch
 ``worker``           at job pickup in the service scheduler, tag = worker id
 ``checkpoint``       before an ingest checkpoint is persisted
+``state_load``       in FileSystemStateProvider.load, tag = repr(analyzer)
+``repository_load``  in the FS metrics repository's read-all, tag = path
+``stream_fold``      before a streaming session's fold mutates state
 ===================  ========================================================
+
+The ``corrupt`` kind (a typed ``CorruptStateError``) injected at the three
+load sites stands in for bit rot/torn writes the checksum layer would
+detect; ``drift`` (a typed ``SchemaDriftError``) at ``stream_fold`` stands
+in for a micro-batch whose schema drifted from the session contract.
 """
 
 from __future__ import annotations
@@ -48,9 +56,11 @@ from typing import Dict, List, Optional, Sequence
 
 from ..exceptions import (
     AnalyzerFaultException,
+    CorruptStateError,
     DeviceFailureException,
     DeviceOOMException,
     PoisonedBatchException,
+    SchemaDriftError,
 )
 
 #: env vars arming a process-wide plan (JSON spec list / int seed)
@@ -91,12 +101,16 @@ def _make_error(kind: str, site: str, tag: str) -> BaseException:
         return InjectedInterrupt(note)
     if kind == "worker_death":
         return WorkerCrash(note)
+    if kind == "corrupt":
+        return CorruptStateError("injected payload", site, note)
+    if kind == "drift":
+        return SchemaDriftError(site, [note])
     raise ValueError(f"unknown fault kind {kind!r}")
 
 
 FAULT_KINDS = (
     "device", "oom", "poison", "analyzer", "interrupt", "worker_death",
-    "stall",
+    "stall", "corrupt", "drift",
 )
 
 

@@ -52,9 +52,25 @@ def classify_failure(exc: BaseException) -> str:
     jax/jaxlib runtime errors (which carry no type hierarchy worth
     matching on) classify by the XLA status phrases they embed. Anything
     else is a data/analyzer-level failure: re-running it elsewhere would
-    fail the same way, so only bisection helps."""
-    from ..exceptions import DeviceFailureException, DeviceOOMException
+    fail the same way, so only bisection helps.
 
+    Integrity taxonomy: :class:`ScanStallError` is a
+    ``DeviceFailureException`` subclass and therefore classifies
+    ``"device"`` — a watchdog-cancelled pass takes the tier-failover +
+    placement-probation path like a thrown device fault.
+    :class:`CorruptStateError` classifies ``"data"`` — a corrupt persisted
+    payload reproduces identically on any tier, so the recovery is
+    degradation (typed Failure metrics for exactly the analyzers that
+    needed it) or the loader-level quarantine/fresh-fold fallbacks, never
+    a pointless re-run elsewhere."""
+    from ..exceptions import (
+        CorruptStateError,
+        DeviceFailureException,
+        DeviceOOMException,
+    )
+
+    if isinstance(exc, CorruptStateError):
+        return "data"
     if isinstance(exc, DeviceOOMException):
         return "oom"
     if isinstance(exc, DeviceFailureException):

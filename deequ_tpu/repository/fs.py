@@ -4,13 +4,25 @@ histories, exactly the reference's strategy
 (reference `repository/fs/FileSystemMetricsRepository.scala:41-57`). The
 path may be local or any URI scheme `deequ_tpu.io` supports (``s3://``,
 ``gs://``, ``memory://``, ...) — the reference reads/writes the same file
-through Hadoop `FileSystem` (`io/DfsUtils.scala:24-85`)."""
+through Hadoop `FileSystem` (`io/DfsUtils.scala:24-85`).
+
+Integrity: every entry carries an xxhash64 content checksum
+(`serde.serialize_result`); a corrupt entry — flipped byte, torn write,
+concurrent-writer shear — is QUARANTINED to a ``<path>.quarantine/``
+sidecar and counted, instead of poisoning every query loader over the
+history. Corruption never crashes a reader: the remaining entries keep
+serving (the same partial-results-are-a-feature stance the analyzer
+taxonomy takes)."""
 
 from __future__ import annotations
 
-from typing import List, Optional
+import json
+import logging
+import threading
+from typing import Any, List, Optional
 
 from .. import io as dio
+from ..exceptions import CorruptStateError
 from ..runners.context import AnalyzerContext
 from . import (
     AnalysisResult,
@@ -18,18 +30,52 @@ from . import (
     MetricsRepositoryMultipleResultsLoader,
     ResultKey,
 )
-from .serde import deserialize_results, serialize_results
+from .serde import deserialize_result, serialize_results
+
+_logger = logging.getLogger(__name__)
+
+#: process-wide count of quarantined repository payloads (entries or whole
+#: files), for tests and the chaos soak; per-run attribution goes through
+#: the repository's optional RunMonitor
+_QUARANTINE_LOCK = threading.Lock()
+_QUARANTINED_TOTAL = 0
+
+
+def quarantined_total() -> int:
+    with _QUARANTINE_LOCK:
+        return _QUARANTINED_TOTAL
+
+
+def _count_quarantine(n: int = 1) -> None:
+    global _QUARANTINED_TOTAL
+    with _QUARANTINE_LOCK:
+        _QUARANTINED_TOTAL += n
 
 
 class FileSystemMetricsRepository(MetricsRepository):
-    def __init__(self, path: str):
+    """``monitor`` (a ``RunMonitor``), when given, records quarantines on
+    its ``corrupt_quarantined`` counter so a run's artifact shows the
+    corruption it survived."""
+
+    def __init__(self, path: str, monitor: Optional[Any] = None):
         self.path = path
+        self.monitor = monitor
 
     def save(self, result_key: ResultKey, analyzer_context: AnalyzerContext) -> None:
         successful = AnalyzerContext(
             {a: m for a, m in analyzer_context.metric_map.items() if m.value.is_success}
         )
-        existing = [r for r in self._read_all() if r.result_key != result_key]
+        # raise_on_torn_file: QUERIES over a structurally-torn history may
+        # serve the empty set (quarantine-and-continue), but a SAVE must
+        # not follow by rewriting the file with only the new entry — that
+        # would silently erase every entry the torn file still holds.
+        # Saving raises typed instead; the operator restores/clears the
+        # file (the quarantine sidecar preserves its bytes) and retries.
+        existing = [
+            r
+            for r in self._read_all(raise_on_torn_file=True)
+            if r.result_key != result_key
+        ]
         existing.append(AnalysisResult(result_key, successful))
         payload = serialize_results(existing)
         # local: write-rename so a crash mid-write never corrupts the
@@ -45,14 +91,75 @@ class FileSystemMetricsRepository(MetricsRepository):
     def load(self) -> "FileSystemMetricsRepositoryMultipleResultsLoader":
         return FileSystemMetricsRepositoryMultipleResultsLoader(self)
 
-    def _read_all(self) -> List[AnalysisResult]:
+    # -- quarantine ----------------------------------------------------------
+
+    def _quarantine(self, payload: str, reason: str, kind: str) -> None:
+        """Copy a corrupt payload into the ``<path>.quarantine/`` sidecar
+        and count it. Sidecar names are CONTENT-ADDRESSED (the payload's
+        checksum), so re-reading the same unrepaired corruption for weeks
+        rewrites one idempotent file instead of accumulating a timestamped
+        copy per read — and concurrent quarantines of one payload land on
+        one name. Quarantine is best-effort: failing to WRITE the sidecar
+        (read-only store) must not turn a survivable corruption into a
+        crash — the payload is still skipped, just not preserved."""
+        from ..integrity import checksum_bytes
+
+        side_dir = self.path + ".quarantine"
+        name = f"{kind}-{checksum_bytes(payload.encode('utf-8'))}.json"
+        try:
+            dio.makedirs(side_dir)
+            dio.write_text_atomic(dio.join(side_dir, name), payload)
+            where = dio.join(side_dir, name)
+        except Exception:  # noqa: BLE001 - best-effort preservation
+            where = "<unwritable quarantine dir>"
+        _count_quarantine()
+        if self.monitor is not None:
+            try:
+                self.monitor.bump("corrupt_quarantined")
+            except Exception:  # noqa: BLE001 - observability only
+                pass
+        _logger.warning(
+            "quarantined corrupt repository %s from %s to %s: %s",
+            kind, self.path, where, reason,
+        )
+
+    def _read_all(
+        self, raise_on_torn_file: bool = False
+    ) -> List[AnalysisResult]:
+        from ..reliability.faults import fault_point
+
         if not dio.exists(self.path):
             return []
         with dio.open_file(self.path, "r") as f:
             payload = f.read()
         if not payload.strip():
             return []
-        return deserialize_results(payload)
+        try:
+            # chaos site: an injected "corrupt" fault here stands in for a
+            # history file whose bytes rotted between writes — it takes the
+            # SAME whole-file quarantine path a torn JSON payload takes
+            fault_point("repository_load", tag=self.path)
+            entries = json.loads(payload)
+        except (ValueError, CorruptStateError) as exc:
+            # the file itself is torn (a flip landed on JSON structure):
+            # quarantine the whole payload; queries serve an empty history,
+            # saves refuse (see ``save``) so valid entries are never
+            # rewritten away
+            self._quarantine(payload, str(exc), "file")
+            if raise_on_torn_file:
+                raise CorruptStateError(
+                    "metrics-repository file", self.path, str(exc)
+                ) from exc
+            return []
+        results: List[AnalysisResult] = []
+        for entry in entries:
+            try:
+                results.append(deserialize_result(entry, source=self.path))
+            except CorruptStateError as exc:
+                self._quarantine(
+                    json.dumps(entry), str(exc), "entry"
+                )
+        return results
 
 
 class FileSystemMetricsRepositoryMultipleResultsLoader(MetricsRepositoryMultipleResultsLoader):

@@ -260,7 +260,7 @@ def serialize_result(result) -> Dict[str, Any]:
             )
         except SerializationError:
             continue  # skip non-serializable analyzers, keep the rest
-    return {
+    payload = {
         "formatVersion": SERDE_FORMAT_VERSION,
         "resultKey": {
             "dataSetDate": result.result_key.data_set_date,
@@ -268,10 +268,21 @@ def serialize_result(result) -> Dict[str, Any]:
         },
         "analyzerContext": {"metricMap": pairs},
     }
+    # per-ENTRY content checksum over the canonical JSON of everything
+    # above: one flipped byte in one entry fails exactly that entry's
+    # verification, so the loader can quarantine it and keep serving the
+    # rest of the history (a whole-file checksum would poison every query)
+    from ..integrity import checksum_json
+
+    payload["checksum"] = checksum_json(
+        {k: v for k, v in payload.items() if k != "checksum"}
+    )
+    return payload
 
 
-def deserialize_result(d: Dict[str, Any]):
+def deserialize_result(d: Dict[str, Any], *, source: str = "<memory>"):
     from . import AnalysisResult, ResultKey
+    from ..exceptions import CorruptStateError
 
     # payloads from before versioning (round <=3) carry no marker and ARE
     # the v1 layout; anything newer than this build understands is refused
@@ -282,11 +293,30 @@ def deserialize_result(d: Dict[str, Any]):
         raise UnsupportedFormatVersionError(
             "metrics-history JSON", version, SERDE_FORMAT_VERSION
         )
-    key = ResultKey(d["resultKey"]["dataSetDate"], d["resultKey"].get("tags", {}))
-    metric_map = {}
-    for pair in d["analyzerContext"]["metricMap"]:
-        analyzer = deserialize_analyzer(pair["analyzer"])
-        metric_map[analyzer] = deserialize_metric(pair["metric"])
+    if "checksum" in d:
+        from ..integrity import verify_json_checksum
+
+        verify_json_checksum(
+            {k: v for k, v in d.items() if k != "checksum"},
+            d["checksum"], "metrics-repository entry", source,
+        )
+    else:
+        from ..integrity import warn_once_unchecksummed
+
+        warn_once_unchecksummed("metrics-repository entry", source)
+    try:
+        key = ResultKey(d["resultKey"]["dataSetDate"], d["resultKey"].get("tags", {}))
+        metric_map = {}
+        for pair in d["analyzerContext"]["metricMap"]:
+            analyzer = deserialize_analyzer(pair["analyzer"])
+            metric_map[analyzer] = deserialize_metric(pair["metric"])
+    except (KeyError, TypeError, ValueError) as exc:
+        # a structurally-torn entry that somehow kept a valid checksum (or
+        # never had one) still surfaces as the one typed error the
+        # quarantine path keys on, not a shape-dependent crash
+        raise CorruptStateError(
+            "metrics-repository entry", source, str(exc)
+        ) from exc
     return AnalysisResult(key, AnalyzerContext(metric_map))
 
 

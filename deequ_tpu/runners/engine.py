@@ -69,6 +69,15 @@ class RunMonitor:
     degraded: List[str] = field(default_factory=list)
     checkpoint_saves: int = 0
     resumed_at_batch: Optional[int] = None
+    #: corrupt persisted payloads (repository entries, checkpoint states)
+    #: this run's loaders quarantined or discarded instead of crashing on
+    corrupt_quarantined: int = 0
+    #: passes the scan watchdog cancelled for exceeding their deadline
+    stalls: int = 0
+    #: the subset of ``stalls`` that happened on the DEVICE tier — the
+    #: placement router's probation signal (a host-tier hang must not pin
+    #: the battery onto the tier that hung)
+    device_stalls: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -85,6 +94,9 @@ class RunMonitor:
         self.degraded = []
         self.checkpoint_saves = 0
         self.resumed_at_batch = None
+        self.corrupt_quarantined = 0
+        self.stalls = 0
+        self.device_stalls = 0
 
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
@@ -1439,10 +1451,59 @@ class ScanEngine:
         else:
             tracer = contextlib.nullcontext()
         with tracer:
-            return self._run_inner(
-                data, batch_size, host_accumulators, host_update_fns, columns,
-                checkpointer, slim_fetch,
+            from ..reliability.watchdog import (
+                rate_tracker,
+                run_with_deadline,
+                scan_deadline_s,
             )
+
+            bs = effective_batch_size(data, batch_size)
+            n_batches = max(1, -(-int(data.num_rows) // bs))
+            n_rows = max(1, int(data.num_rows))
+            tier = self._resolve_placement_inner()
+            deadline = scan_deadline_s(n_rows, tier)
+            bypass = getattr(_CACHE_BYPASS, "active", False)
+            import time
+
+            batches_before = self.monitor.batches
+            t0 = time.perf_counter()
+            if deadline is None:
+                result = self._run_inner(
+                    data, batch_size, host_accumulators, host_update_fns,
+                    columns, checkpointer, slim_fetch,
+                )
+            else:
+                # the pass body moves to the watchdog's worker thread; the
+                # per-thread cache-bypass flag (background warm runs) must
+                # move with it or a warm sample would enter the budget
+                def pass_body():
+                    _CACHE_BYPASS.active = bypass
+                    return self._run_inner(
+                        data, batch_size, host_accumulators, host_update_fns,
+                        columns, checkpointer, slim_fetch,
+                    )
+
+                result = run_with_deadline(
+                    pass_body, deadline, self.monitor, tier
+                )
+            # only COMPLETED passes teach the rate tracker, and only
+            # REPRESENTATIVE ones: background warm runs (1-row samples
+            # under the cache bypass) and the batches a resume skipped
+            # would both poison the EWMA toward a deadline no production
+            # pass can meet — observe the batches this pass actually
+            # processed (the monitor delta), never the nominal count. A
+            # delta EXCEEDING the pass's own batch count proves another
+            # pass (a watchdog-abandoned zombie, an overlapped profile
+            # scan) bumped the shared monitor concurrently — skip the
+            # observation rather than learn a contaminated rate
+            if not bypass:
+                folded = self.monitor.batches - batches_before
+                if 0 < folded <= n_batches:
+                    rate_tracker().observe(
+                        tier, min(folded * bs, n_rows),
+                        time.perf_counter() - t0,
+                    )
+            return result
 
     def _run_inner(
         self,
@@ -1477,10 +1538,15 @@ class ScanEngine:
             )
             ckpt = None
         resume = None
+        ckpt_epoch = None
         if ckpt is not None:
+            # fence any earlier pass over this checkpointer FIRST: a
+            # watchdog-abandoned zombie still folding must not interleave
+            # its saves with this pass's (see IngestCheckpointer.begin_run)
+            ckpt_epoch = ckpt.begin_run()
             resume = ckpt.load(
                 bs, int(data.num_rows), list(self.scan_analyzers),
-                list(host_states),
+                list(host_states), monitor=monitor,
             )
             if resume is not None:
                 monitor.resumed_at_batch = resume.batch_index
@@ -1497,6 +1563,7 @@ class ScanEngine:
             return self._run_host_tier(
                 data, bs, host_states, update_fns, columns,
                 checkpointer=ckpt, resume=resume, slim_fetch=slim_fetch,
+                ckpt_epoch=ckpt_epoch,
             )
         if has_battery and self._update is None:
             # constructed under a host resolution but asked to run device
@@ -1576,6 +1643,7 @@ class ScanEngine:
                 ckpt.save(
                     folded, bs, int(data.num_rows),
                     list(self.scan_analyzers), ck_states, host_states,
+                    epoch=ckpt_epoch,
                 )
                 monitor.bump("checkpoint_saves")
 
@@ -1600,7 +1668,7 @@ class ScanEngine:
                 if ckpt is not None and folded % ckpt.every == 0:
                     save_checkpoint()
         if ckpt is not None:
-            ckpt.complete()
+            ckpt.complete(ckpt_epoch)
         if carry is not None:
             # drain the async dispatch queue UNDER the dispatch timer:
             # device execution time belongs to device_dispatch, so the
@@ -1624,7 +1692,7 @@ class ScanEngine:
     def _run_host_tier(
         self, data, bs, host_states, update_fns, columns,
         checkpointer: Optional[Any] = None, resume: Optional[Any] = None,
-        slim_fetch: bool = False,
+        slim_fetch: bool = False, ckpt_epoch: Optional[int] = None,
     ) -> Tuple[List[Any], Dict[Any, Any]]:
         """Host ingest tier: per-batch partial states next to the data, then
         chunked device folds of the stacked partials (+ one packed state
@@ -1768,7 +1836,7 @@ class ScanEngine:
                 checkpointer.save(
                     progress["folded"], bs, int(data.num_rows),
                     list(analyzers), _fetch_states_packed(tuple(states)),
-                    host_states, host_batch_index=n,
+                    host_states, host_batch_index=n, epoch=ckpt_epoch,
                 )
                 monitor.bump("checkpoint_saves")
             progress["saved"] = progress["folded"]
@@ -1836,7 +1904,7 @@ class ScanEngine:
 
             states = collective_merge_states(analyzers, mesh, states)
         if checkpointer is not None and mesh is None:
-            checkpointer.complete()
+            checkpointer.complete(ckpt_epoch)
         with monitor.timed("state_fetch"):
             host_side = _fetch_states_packed(
                 states, analyzers=analyzers if slim_fetch else None

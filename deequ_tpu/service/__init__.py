@@ -46,6 +46,8 @@ from .errors import (
     SessionClosed,
     TransientFailure,
 )
+from ..exceptions import SchemaDriftError
+from .drift import DriftReport, SchemaContract
 from .metrics import MetricsExporter, ServiceMetrics
 from .placement import (
     PlacementRouter,
@@ -63,6 +65,7 @@ __all__ = [
     "ServiceMetrics", "MetricsExporter",
     "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
     "TransientFailure", "SessionClosed", "ServiceClosed",
+    "SchemaContract", "DriftReport", "SchemaDriftError",
 ]
 
 

@@ -188,6 +188,11 @@ class JobScheduler:
             "Analyzers/accumulators degraded to typed Failure metrics "
             "instead of failing their whole run.",
         )
+        self.metrics.describe(
+            "deequ_service_scan_stalls_total",
+            "Engine passes cancelled by the scan watchdog for exceeding "
+            "their deadline (hang-not-crash faults).",
+        )
         self.metrics.set_gauge_fn(
             "deequ_service_queue_depth", self.pending,
             "Jobs admitted but not yet running.",
@@ -437,7 +442,20 @@ class JobScheduler:
                 job.handle.phase_seconds.get(phase, 0.0) + seconds
             )
         monitor = ctx.monitor
-        if monitor.device_failovers or monitor.batch_bisections:
+        if monitor.stalls:
+            # every stall surfaces on the export plane; only DEVICE-tier
+            # stalls feed probation below (pinning a battery to the host
+            # tier because the HOST hung would probation it onto the sick
+            # tier)
+            self.metrics.inc(
+                "deequ_service_scan_stalls_total",
+                float(monitor.stalls), tenant=job.tenant,
+            )
+        if (
+            monitor.device_failovers
+            or monitor.batch_bisections
+            or monitor.device_stalls
+        ):
             # the engine survived a device-tier fault under this battery:
             # teach the router to keep the battery on the host tier for a
             # probation window (also fires on failed attempts, so a retry
@@ -455,8 +473,16 @@ class JobScheduler:
             )
 
     def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:
-        retryable = isinstance(exc, TransientFailure) or (
-            job.retry_on and isinstance(exc, job.retry_on)
+        from ..exceptions import ScanStallError
+
+        # an ESCAPED stall (both tiers hung, or the battery could not fail
+        # over) is retryable by construction: the watchdog already killed
+        # the pass, the worker is free, and the placement router has moved
+        # the battery onto probation — requeueing gives the job its healthy
+        # tier instead of failing it outright
+        retryable = (
+            isinstance(exc, (TransientFailure, ScanStallError))
+            or (job.retry_on and isinstance(exc, job.retry_on))
         )
         if not retryable or job.attempts > job.max_retries:
             return False

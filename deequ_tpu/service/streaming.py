@@ -16,6 +16,14 @@ Batches enter through the service scheduler (admission control, deadlines,
 retry, cache-aware placement all apply); merges within one session are
 serialized by a session lock, so concurrent ingests never interleave their
 load-merge-persist cycles.
+
+Schema integrity: the first folded batch captures a
+:class:`~deequ_tpu.service.drift.SchemaContract` (column names, value
+dtypes, dictionary-encoding) and every later batch validates against it
+BEFORE the fold — compatible widenings (int32 arriving where int64 was
+promised) are coerced and counted; incompatible drift (column added,
+dropped, retyped) raises a typed ``SchemaDriftError`` with the persisted
+states untouched, or coerces/degrades per the session's ``drift_policy``.
 """
 
 from __future__ import annotations
@@ -77,6 +85,7 @@ class StreamingSession:
         batch_size: Optional[int] = None,
         on_result: Optional[Callable[[Any], None]] = None,
         keep_results: int = 256,
+        drift_policy: str = "reject",
     ):
         # max_retries defaults to 0 because a fold MUTATES persisted state:
         # a transient failure in the middle of a run can leave some
@@ -104,9 +113,31 @@ class StreamingSession:
         self.max_retries = max_retries
         self.batch_size = batch_size
         self.on_result = on_result
+        from .drift import DRIFT_POLICIES
+
+        if drift_policy not in DRIFT_POLICIES:
+            raise ValueError(
+                f"drift_policy must be one of {DRIFT_POLICIES}, "
+                f"got {drift_policy!r}"
+            )
+        self.drift_policy = drift_policy
         self._serial = threading.Lock()  # orders load-merge-persist cycles
         self._closed = False
         self._schema = None
+        #: the schema promise captured from the FIRST folded batch; every
+        #: later batch validates against it BEFORE the fold so persisted
+        #: states are never contaminated by mixed-schema merges. For a
+        #: DURABLE (path-backed) provider the contract persists beside the
+        #: states, so a session resumed in a new process still validates
+        #: against the schema its persisted states were folded under — a
+        #: fresh capture from the first post-restart batch would let a
+        #: drifted producer contaminate days of state unchallenged
+        self._contract = self._load_contract()
+        #: drift observability: widenings coerced / batches folded degraded
+        #: / batches whose HARD drift the coerce policy repaired
+        self.drift_coercions = 0
+        self.drift_degraded_batches = 0
+        self.drift_repaired_batches = 0
         import itertools
 
         #: per-SUBMISSION counter for job ids — batches_ingested only moves
@@ -172,7 +203,7 @@ class StreamingSession:
             serial_key=(self.tenant, self.dataset),
         )
         if wait:
-            from .errors import JobTimeout
+            from .errors import JobFailed, JobTimeout
 
             try:
                 return handle.result(timeout)
@@ -182,6 +213,15 @@ class StreamingSession:
                     # into the persisted states — hand back the committed
                     # result rather than baiting a double-counting retry
                     return handle.late_value
+                raise
+            except JobFailed as exc:
+                from ..exceptions import SchemaDriftError
+
+                if isinstance(exc.__cause__, SchemaDriftError):
+                    # surface the drift contract directly: the caller's
+                    # remedy (fix the producer, change drift_policy) has
+                    # nothing to do with job plumbing
+                    raise exc.__cause__
                 raise
         return handle
 
@@ -203,6 +243,18 @@ class StreamingSession:
         with self._serial:
             if self._closed:
                 raise SessionClosed(self.tenant, self.dataset)
+            pending_contract = None
+            if self._contract is None:
+                # the contract COMMITS only after this batch's fold
+                # succeeds: a first batch whose fold raises never folded,
+                # so its schema must not pin the session (a wrong-schema
+                # first batch would otherwise reject every corrected
+                # batch after it until an operator deleted the contract)
+                from .drift import SchemaContract
+
+                pending_contract = SchemaContract.capture(data)
+            else:
+                data = self._guard_schema(data)
             result = VerificationSuite.do_verification_run(
                 data,
                 self.checks,
@@ -215,6 +267,9 @@ class StreamingSession:
                 placement=ctx.placement,
             )
             done["result"] = result
+            if pending_contract is not None:
+                self._contract = pending_contract
+                self._store_contract()
             self._schema = self._schema or data.schema
             self.batches_ingested += 1
             self.rows_ingested += int(data.num_rows)
@@ -237,6 +292,129 @@ class StreamingSession:
                     status=result.status.value,
                 )
         return self._notify(done)
+
+    def _guard_schema(self, data: Dataset) -> Dataset:
+        """The drift guard, run under the serial lock BEFORE anything
+        mutates; the contract itself is captured (and committed only
+        after a successful fold) in ``_fold_batch``. Raises typed
+        ``SchemaDriftError`` (policy ``reject``, or an un-coercible
+        batch) with persisted states untouched; returns the (possibly
+        repaired) dataset to fold otherwise."""
+        from ..exceptions import SchemaDriftError
+
+        metrics = self.service.metrics
+        try:
+            report = self._contract.validate(
+                data,
+                policy=self.drift_policy,
+                session=f"{self.tenant}/{self.dataset}",
+            )
+        except SchemaDriftError:
+            metrics.inc(
+                "deequ_service_drift_rejections_total",
+                tenant=self.tenant, dataset=self.dataset,
+            )
+            raise
+        if report.coercions:
+            self.drift_coercions += len(report.coercions)
+            metrics.inc(
+                "deequ_service_drift_coercions_total",
+                float(len(report.coercions)),
+                tenant=self.tenant, dataset=self.dataset,
+            )
+        if report.repaired:
+            self.drift_repaired_batches += 1
+            metrics.inc(
+                "deequ_service_drift_repairs_total",
+                tenant=self.tenant, dataset=self.dataset,
+            )
+            _logger.warning(
+                "session %s/%s coerce-repaired hard schema drift before "
+                "folding: %s — the producer's schema changed",
+                self.tenant, self.dataset, report.repaired,
+            )
+        if report.degraded:
+            self.drift_degraded_batches += 1
+            metrics.inc(
+                "deequ_service_drift_degraded_total",
+                tenant=self.tenant, dataset=self.dataset,
+            )
+            _logger.warning(
+                "session %s/%s folding batch with %d drifted column(s) "
+                "degraded per policy: %s",
+                self.tenant, self.dataset, len(report.degraded),
+                report.degraded,
+            )
+        if report.table is None:
+            return data
+        return Dataset.from_arrow(report.table)
+
+    # -- contract persistence ------------------------------------------------
+
+    _CONTRACT_FILENAME = "schema-contract.json"
+
+    def _contract_path(self):
+        path = getattr(self.provider, "path", None)
+        if path is None:
+            return None
+        from .. import io as dio
+
+        return dio.join(path, self._CONTRACT_FILENAME)
+
+    def _load_contract(self):
+        path = self._contract_path()
+        if path is None:
+            return None
+        import json
+
+        from .. import io as dio
+        from .drift import ColumnContract, SchemaContract
+
+        if not dio.exists(path):
+            return None
+        try:
+            with dio.open_file(path, "r") as fh:
+                d = json.load(fh)
+            from ..integrity import verify_json_checksum
+
+            verify_json_checksum(
+                {k: v for k, v in d.items() if k != "checksum"},
+                d.get("checksum", ""), "schema contract", path,
+            )
+            return SchemaContract(
+                tuple(ColumnContract(**c) for c in d["columns"])
+            )
+        except Exception:  # noqa: BLE001 - recapture beats refusing folds
+            _logger.warning(
+                "schema contract at %s is unreadable or corrupt; "
+                "re-capturing from the next folded batch", path,
+                exc_info=True,
+            )
+            return None
+
+    def _store_contract(self) -> None:
+        path = self._contract_path()
+        if path is None:
+            return
+        import json
+
+        from .. import io as dio
+        from ..integrity import checksum_json
+
+        d = {
+            "columns": [
+                {"name": c.name, "dtype": c.dtype, "dictionary": c.dictionary}
+                for c in self._contract.columns
+            ]
+        }
+        d["checksum"] = checksum_json(d)
+        try:
+            dio.write_text_atomic(path, json.dumps(d))
+        except Exception:  # noqa: BLE001 - durability is best-effort;
+            # the in-process contract still guards every fold
+            _logger.warning(
+                "could not persist schema contract to %s", path, exc_info=True
+            )
 
     def _notify(self, done: dict):
         """Deliver on_result at most once per fold, CONTAINED: by the time

@@ -1,0 +1,385 @@
+"""Streaming schema-drift drills (ISSUE 4 acceptance): a session fed a
+retyped column rejects/coerces/degrades per policy, with persisted states
+untouched on reject; widenings coerce with fold parity; the
+batch-count/column-name mismatch that used to silently mis-fold is an
+immediate typed error."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.exceptions import SchemaDriftError
+from deequ_tpu.service import SchemaContract, VerificationService
+
+
+def _batch(rows=64, x_dtype=np.int64, with_y=True, y_values=None, extra=False):
+    cols = {"x": np.arange(rows, dtype=x_dtype)}
+    if with_y:
+        cols["y"] = (
+            y_values if y_values is not None
+            else np.arange(rows, dtype=np.float64)
+        )
+    if extra:
+        cols["z"] = np.ones(rows)
+    return Dataset.from_dict(cols)
+
+
+def _checks():
+    return [
+        Check(CheckLevel.ERROR, "drift battery")
+        .has_size(lambda n: n > 0)
+        .has_mean("y", lambda m: m >= 0)
+        .is_complete("x"),
+    ]
+
+
+@pytest.fixture
+def service():
+    with VerificationService(workers=2, background_warm=False) as svc:
+        yield svc
+
+
+def _state_snapshot(session):
+    """Every persisted state's leaves as host numpy (order-stable)."""
+    import jax
+
+    out = {}
+    for analyzer in session.provider.analyzers():
+        leaves = jax.tree_util.tree_leaves(session.provider.load(analyzer))
+        out[repr(analyzer)] = [np.asarray(l).copy() for l in leaves]
+    return out
+
+
+def _assert_states_equal(a, b):
+    assert a.keys() == b.keys()
+    for key in a:
+        for la, lb in zip(a[key], b[key]):
+            np.testing.assert_array_equal(la, lb)
+
+
+class TestContractUnit:
+    def test_capture_records_names_dtypes_encoding(self):
+        import pyarrow as pa
+
+        data = Dataset.from_arrow(
+            pa.table(
+                {
+                    "n": pa.array(np.arange(8, dtype=np.int32)),
+                    "s": pa.array(["a", "b"] * 4),
+                    "d": pa.DictionaryArray.from_arrays(
+                        pa.array([0, 1] * 4, type=pa.int32()),
+                        pa.array(["u", "v"]),
+                    ),
+                }
+            )
+        )
+        contract = SchemaContract.capture(data)
+        by_name = {c.name: c for c in contract.columns}
+        assert by_name["n"].dtype == "int32" and not by_name["n"].dictionary
+        assert by_name["s"].dtype == "string"
+        assert by_name["d"].dictionary and by_name["d"].dtype == "string"
+
+    def test_reordered_columns_are_not_drift(self):
+        first = Dataset.from_dict(
+            {"a": np.arange(4, dtype=np.int64), "b": np.ones(4)}
+        )
+        contract = SchemaContract.capture(first)
+        reordered = Dataset.from_dict(
+            {"b": np.ones(4), "a": np.arange(4, dtype=np.int64)}
+        )
+        report = contract.validate(reordered)
+        assert report.table is None and not report.coercions
+
+    def test_widening_coerces_under_every_policy(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.arange(4, dtype=np.int64)})
+        )
+        narrow = Dataset.from_dict({"a": np.arange(4, dtype=np.int32)})
+        for policy in ("reject", "coerce", "degrade"):
+            report = contract.validate(narrow, policy=policy)
+            assert report.coercions == ["a: int32 -> int64"]
+            assert str(report.table.schema.field("a").type) == "int64"
+
+    def test_narrowing_is_drift_not_widening(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.arange(4, dtype=np.int32)})
+        )
+        wide = Dataset.from_dict({"a": np.arange(4, dtype=np.int64)})
+        with pytest.raises(SchemaDriftError, match="retyped"):
+            contract.validate(wide)
+
+    def test_int_to_float_is_not_a_widening(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.arange(4, dtype=np.float64)})
+        )
+        ints = Dataset.from_dict({"a": np.arange(4, dtype=np.int64)})
+        with pytest.raises(SchemaDriftError, match="retyped"):
+            contract.validate(ints)
+
+    def test_coerce_rejects_unrepresentable_values(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.arange(4, dtype=np.int64)})
+        )
+        words = Dataset.from_dict({"a": ["not", "a", "number", "!"]})
+        with pytest.raises(SchemaDriftError, match="cannot be coerced"):
+            contract.validate(words, policy="coerce")
+
+    def test_coerce_casts_castable_retypes(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.arange(4, dtype=np.int64)})
+        )
+        digits = Dataset.from_dict({"a": ["0", "1", "2", "3"]})
+        report = contract.validate(digits, policy="coerce")
+        assert str(report.table.schema.field("a").type) == "int64"
+        assert report.table["a"].to_pylist() == [0, 1, 2, 3]
+
+    def test_dropped_column_never_coercible(self):
+        contract = SchemaContract.capture(
+            Dataset.from_dict({"a": np.ones(4), "b": np.ones(4)})
+        )
+        missing = Dataset.from_dict({"a": np.ones(4)})
+        for policy in ("reject", "coerce"):
+            with pytest.raises(SchemaDriftError, match="dropped"):
+                contract.validate(missing, policy=policy)
+
+    def test_dictionary_flip_is_drift(self):
+        import pyarrow as pa
+
+        contract = SchemaContract.capture(
+            Dataset.from_arrow(
+                pa.table(
+                    {
+                        "d": pa.DictionaryArray.from_arrays(
+                            pa.array([0, 1] * 4, type=pa.int32()),
+                            pa.array(["u", "v"]),
+                        )
+                    }
+                )
+            )
+        )
+        plain = Dataset.from_arrow(
+            pa.table({"d": pa.array(["u", "v"] * 4)}),
+        )
+        # a plain column where a dictionary was promised: reject raises,
+        # coerce re-encodes
+        if plain.arrow.schema.field("d").type == "string":
+            with pytest.raises(SchemaDriftError, match="dictionary"):
+                contract.validate(plain)
+            report = contract.validate(plain, policy="coerce")
+            import pyarrow as pa2
+
+            assert pa2.types.is_dictionary(report.table.schema.field("d").type)
+
+    def test_invalid_policy_rejected(self):
+        contract = SchemaContract.capture(Dataset.from_dict({"a": np.ones(2)}))
+        with pytest.raises(ValueError, match="drift_policy"):
+            contract.validate(
+                Dataset.from_dict({"a": np.ones(2)}), policy="panic"
+            )
+
+
+class TestSessionDriftGuard:
+    def test_column_name_mismatch_is_immediate_typed_error(self, service):
+        """The PR-4 satellite bugfix: the session used to only STORE the
+        first schema and silently mis-fold renamed/added columns."""
+        session = service.session("t", "names", _checks())
+        session.ingest(_batch())
+        renamed = Dataset.from_dict(
+            {"x": np.arange(64, dtype=np.int64), "y2": np.ones(64)}
+        )
+        with pytest.raises(SchemaDriftError) as err:
+            session.ingest(renamed)
+        assert "dropped" in str(err.value) and "added" in str(err.value)
+        assert session.batches_ingested == 1  # nothing folded
+
+    def test_reject_leaves_persisted_states_bit_exact(self, service):
+        session = service.session("t", "reject", _checks())
+        session.ingest(_batch(rows=128))
+        session.ingest(_batch(rows=64))
+        before = _state_snapshot(session)
+        retyped = _batch(
+            rows=64, y_values=np.array([f"s{i}" for i in range(64)])
+        )
+        with pytest.raises(SchemaDriftError, match="retyped"):
+            session.ingest(retyped)
+        _assert_states_equal(before, _state_snapshot(session))
+        assert session.batches_ingested == 2
+
+    def test_widened_fold_parity_with_native_batches(self, service):
+        """Folding an int32 batch into an int64 session equals folding the
+        same values natively int64 — the coercion is exact."""
+        a = service.session("t", "widen-a", _checks())
+        b = service.session("t", "widen-b", _checks())
+        a.ingest(_batch(rows=128))
+        b.ingest(_batch(rows=128))
+        a.ingest(_batch(rows=64, x_dtype=np.int32))  # widened
+        b.ingest(_batch(rows=64, x_dtype=np.int64))  # native
+        assert a.drift_coercions == 1 and b.drift_coercions == 0
+        _assert_states_equal(_state_snapshot(a), _state_snapshot(b))
+
+    def test_degrade_folds_the_rest_and_fails_affected(self, service):
+        session = service.session(
+            "t", "degrade", _checks(), drift_policy="degrade"
+        )
+        session.ingest(_batch(rows=128))
+        retyped = _batch(
+            rows=64, y_values=np.array([f"s{i}" for i in range(64)])
+        )
+        result = session.ingest(retyped)
+        assert result.status != CheckStatus.SUCCESS
+        statuses = {
+            type(a).__name__: m.value.is_success
+            for a, m in result.metrics.items()
+        }
+        assert statuses["Mean"] is False        # over the drifted column
+        assert statuses["Size"] is True         # kept folding
+        assert statuses["Completeness"] is True
+        assert session.drift_degraded_batches == 1
+        assert session.batches_ingested == 2
+        # the unaffected analyzers' states ADVANCED to 128 + 64 rows
+        size_state = session.provider.load(Size())
+        assert int(np.asarray(size_state.num_matches)) == 192
+
+    def test_contract_commits_only_after_first_fold_succeeds(self, service):
+        """A first batch whose fold RAISES never folded — its schema must
+        not pin the session (a wrong-schema first batch would otherwise
+        reject every corrected batch after it)."""
+        from deequ_tpu.reliability import FaultSpec, WorkerCrash, inject
+        from deequ_tpu.service import JobFailed
+
+        session = service.session("t", "firstfail", _checks())
+        with inject(FaultSpec("stream_fold", "worker_death", at=1)):
+            with pytest.raises(JobFailed):
+                session.ingest(_batch(rows=32, x_dtype=np.int32))
+        assert session._contract is None  # nothing folded, nothing pinned
+        # a DIFFERENT schema now captures cleanly as the contract
+        r = session.ingest(_batch(rows=64))
+        assert r.status == CheckStatus.SUCCESS
+        assert {c.dtype for c in session._contract.columns} == {
+            "int64", "double"
+        }
+
+    def test_degrade_surfaces_added_column_on_counters(self, service):
+        """An added column under `degrade` folds without it, but the drift
+        must still surface (counter + warning), not vanish silently."""
+        session = service.session(
+            "t", "deg-add", _checks(), drift_policy="degrade"
+        )
+        session.ingest(_batch(rows=128))
+        r = session.ingest(_batch(rows=64, extra=True))
+        assert r.status == CheckStatus.SUCCESS  # no analyzer was affected
+        assert session.drift_degraded_batches == 1
+        counters = service.json_snapshot()["counters"]
+        assert (
+            counters["deequ_service_drift_degraded_total"][
+                "dataset=deg-add,tenant=t"
+            ]
+            == 1.0
+        )
+
+    def test_coerce_drops_added_columns_and_folds(self, service):
+        session = service.session(
+            "t", "coerce", _checks(), drift_policy="coerce"
+        )
+        session.ingest(_batch(rows=128))
+        result = session.ingest(_batch(rows=64, extra=True))
+        assert result.status == CheckStatus.SUCCESS
+        assert session.batches_ingested == 2
+        # the repaired hard drift is VISIBLE, not silently consumed
+        assert session.drift_repaired_batches == 1
+        counters = service.json_snapshot()["counters"]
+        assert (
+            counters["deequ_service_drift_repairs_total"][
+                "dataset=coerce,tenant=t"
+            ]
+            == 1.0
+        )
+
+    def test_contract_survives_process_restart(self, tmp_path):
+        """A durably-backed session persists its contract beside the
+        states: a NEW session (new process in real life) over the same
+        store validates its FIRST batch against the old contract instead
+        of letting a drifted producer re-capture it."""
+        from deequ_tpu.service import VerificationService
+
+        root = str(tmp_path / "states")
+        with VerificationService(
+            workers=2, background_warm=False, state_root=root
+        ) as svc:
+            s = svc.session("t", "durable", _checks())
+            s.ingest(_batch(rows=128))
+            assert s._contract is not None
+        # "restart": a fresh service + session over the same state root
+        with VerificationService(
+            workers=2, background_warm=False, state_root=root
+        ) as svc:
+            s2 = svc.session("t", "durable", _checks())
+            assert s2._contract is not None  # loaded, not None
+            retyped = _batch(
+                rows=64, y_values=np.array([f"s{i}" for i in range(64)])
+            )
+            with pytest.raises(SchemaDriftError, match="retyped"):
+                s2.ingest(retyped)  # FIRST post-restart batch: rejected
+            assert s2.batches_ingested == 0
+            # a conforming batch still folds
+            r = s2.ingest(_batch(rows=64))
+            assert r.status == CheckStatus.SUCCESS
+
+    def test_corrupt_contract_file_recaptures(self, tmp_path):
+        from deequ_tpu.service import VerificationService
+
+        root = str(tmp_path / "states")
+        with VerificationService(
+            workers=2, background_warm=False, state_root=root
+        ) as svc:
+            s = svc.session("t", "durable", _checks())
+            s.ingest(_batch(rows=128))
+            path = s._contract_path()
+        raw = open(path).read()
+        i = raw.index("int64") + 1
+        open(path, "w").write(raw[:i] + "X" + raw[i + 1:])
+        with VerificationService(
+            workers=2, background_warm=False, state_root=root
+        ) as svc:
+            s2 = svc.session("t", "durable", _checks())
+            assert s2._contract is None  # corrupt file -> recapture
+            r = s2.ingest(_batch(rows=64))
+            assert r.status == CheckStatus.SUCCESS
+
+    def test_drift_metrics_exported(self, service):
+        session = service.session("t", "metrics", _checks())
+        session.ingest(_batch())
+        with pytest.raises(SchemaDriftError):
+            session.ingest(_batch(extra=True))
+        counters = service.json_snapshot()["counters"]
+        assert (
+            counters["deequ_service_drift_rejections_total"][
+                "dataset=metrics,tenant=t"
+            ]
+            == 1.0
+        )
+
+
+@pytest.mark.chaos
+class TestInjectedDrift:
+    def test_stream_fold_drift_injection_rejects_before_fold(self, service):
+        from deequ_tpu.reliability import FaultSpec, inject
+
+        session = service.session("t", "chaos-drift", _checks())
+        session.ingest(_batch())
+        before = _state_snapshot(session)
+        with inject(FaultSpec("stream_fold", "drift", at=1)) as inj:
+            with pytest.raises(SchemaDriftError):
+                session.ingest(_batch())
+            # the injected drift consumed its budget; the next ingest folds
+            result = session.ingest(_batch())
+        assert inj.fired == ["stream_fold:t/chaos-drift#1:drift"]
+        assert result.status == CheckStatus.SUCCESS
+        assert session.batches_ingested == 2
+        # the rejected ingest mutated nothing: states advanced exactly one
+        # batch past the snapshot
+        size_state = session.provider.load(Size())
+        assert int(np.asarray(size_state.num_matches)) == 128
+        assert before  # snapshot sanity

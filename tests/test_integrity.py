@@ -1,0 +1,451 @@
+"""Data-plane integrity drills (ISSUE 4 acceptance): flipping one byte in
+a persisted state blob, an FS repository entry, or a checkpoint payload
+yields a typed ``CorruptStateError``, quarantine (not crash), and a
+bit-exact resume/recompute — on both the device and host tiers. Plus the
+checksum construction's pinned behavior and the chaos-marked injection
+variants for the ``state_load`` / ``repository_load`` sites."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Histogram,
+    KLLSketch,
+    Mean,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.state_provider import (
+    FileSystemStateProvider,
+    InMemoryStateProvider,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.exceptions import CorruptStateError
+from deequ_tpu.reliability import FaultSpec, IngestCheckpointer, inject
+from deequ_tpu.repository import ResultKey
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+
+def _data(rows=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return Dataset.from_dict(
+        {
+            "x": rng.normal(size=rows),
+            "c": [f"v{i % 37}" for i in range(rows)],
+        }
+    )
+
+
+def _flip_byte(path, offset_fraction=0.5):
+    blob = bytearray(open(path, "rb").read())
+    blob[int(len(blob) * offset_fraction)] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+
+
+class TestChecksumConstruction:
+    def test_small_and_large_paths_are_deterministic(self):
+        from deequ_tpu.integrity import checksum_bytes
+
+        small = b"meta record"
+        big = np.random.default_rng(1).bytes(1 << 20)
+        assert checksum_bytes(small) == checksum_bytes(small)
+        assert checksum_bytes(big) == checksum_bytes(bytes(big))
+        assert len(checksum_bytes(small)) == 16
+        assert len(checksum_bytes(big)) == 16
+
+    def test_single_byte_flip_always_detected(self):
+        """Flip one byte at several positions incl. the un-word-aligned
+        tail: the digest must change every time."""
+        from deequ_tpu.integrity import checksum_bytes
+
+        payload = bytearray(np.random.default_rng(2).bytes((1 << 16) + 5))
+        base = checksum_bytes(bytes(payload))
+        for pos in (0, 7, 8, 1 << 12, len(payload) - 3, len(payload) - 1):
+            flipped = bytearray(payload)
+            flipped[pos] ^= 0x01
+            assert checksum_bytes(bytes(flipped)) != base, pos
+
+    def test_transposed_regions_detected(self):
+        """The position tag makes word swaps visible (a plain XOR of
+        per-word hashes would not see them)."""
+        from deequ_tpu.integrity import checksum_bytes
+
+        payload = bytearray(np.random.default_rng(3).bytes(1 << 14))
+        base = checksum_bytes(bytes(payload))
+        swapped = bytearray(payload)
+        swapped[0:8], swapped[8:16] = payload[8:16], payload[0:8]
+        assert checksum_bytes(bytes(swapped)) != base
+
+    def test_length_extension_detected(self):
+        from deequ_tpu.integrity import checksum_bytes
+
+        payload = np.random.default_rng(4).bytes(1 << 12)
+        assert checksum_bytes(payload) != checksum_bytes(payload + b"\x00")
+
+
+class TestStateBlobCorruption:
+    """Byte-flip drills on the FileSystemStateProvider's two blob
+    families, plus recompute parity through the verification engine."""
+
+    def test_npz_flip_raises_typed(self, tmp_path):
+        data = _data()
+        sp = FileSystemStateProvider(str(tmp_path))
+        AnalysisRunner.do_analysis_run(data, [Mean("x")], save_states_with=sp)
+        for path in glob.glob(str(tmp_path / "*-state.npz")):
+            _flip_byte(path)
+        with pytest.raises(CorruptStateError):
+            sp.load(Mean("x"))
+
+    def test_parquet_flip_raises_typed(self, tmp_path):
+        data = _data()
+        sp = FileSystemStateProvider(str(tmp_path))
+        AnalysisRunner.do_analysis_run(
+            data, [Histogram("c")], save_states_with=sp
+        )
+        for path in glob.glob(str(tmp_path / "*-frequencies.parquet")):
+            _flip_byte(path)
+        with pytest.raises(CorruptStateError):
+            sp.load(Histogram("c"))
+
+    @pytest.mark.parametrize("placement", ["device", "host"])
+    def test_corrupt_aggregate_state_degrades_only_its_analyzer(
+        self, tmp_path, placement
+    ):
+        """A corrupt persisted state under ``aggregate_with`` degrades
+        exactly the analyzer that needed it to a typed Failure metric —
+        the rest of the battery completes with clean-run values, on BOTH
+        tiers; a recompute without the corrupt store is bit-exact."""
+        import shutil
+
+        data = _data()
+        battery = [Mean("x"), Sum("x"), Completeness("x")]
+        store = tmp_path / "store"
+        sp = FileSystemStateProvider(str(store))
+        AnalysisRunner.do_analysis_run(
+            data, battery, save_states_with=sp, placement=placement
+        )
+        pristine = tmp_path / "pristine"
+        shutil.copytree(store, pristine)
+        # corrupt ONLY Mean's blob (keyed file name starts with the
+        # analyzer name)
+        mean_key = sp._key(Mean("x"))
+        _flip_byte(str(store / f"{mean_key}-state.npz"))
+        ctx = AnalysisRunner.do_analysis_run(
+            data, battery, aggregate_with=sp, placement=placement
+        )
+        assert ctx.metric_map[Mean("x")].value.is_failure
+        assert isinstance(
+            ctx.metric_map[Mean("x")].value.exception, CorruptStateError
+        )
+        # the rest of the battery's AGGREGATED values equal a run over an
+        # uncorrupted copy of the same store (bit-exact recompute)
+        clean = AnalysisRunner.do_analysis_run(
+            data, battery,
+            aggregate_with=FileSystemStateProvider(str(pristine)),
+            placement=placement,
+        )
+        for a in (Sum("x"), Completeness("x")):
+            assert (
+                ctx.metric_map[a].value.get()
+                == clean.metric_map[a].value.get()
+            )
+
+    def test_legacy_unchecksummed_blob_still_loads(self, tmp_path, caplog):
+        """A v2 blob WITHOUT the __checksum__ member (pre-integrity build)
+        loads unverified with a warn-once."""
+        import logging
+
+        sp = FileSystemStateProvider(str(tmp_path))
+        a = Mean("x")
+        base = str(tmp_path / sp._key(a))
+        np.savez(
+            base + "-state.npz",
+            __format_version__=np.int64(2),
+            __state_type__=np.str_("MeanState"),
+            __static__=np.str_("{}"),
+            leaf0=np.float64(45.0),
+            leaf1=np.int64(10),
+        )
+        with caplog.at_level(logging.WARNING, logger="deequ_tpu.integrity"):
+            state = sp.load(a)
+        assert float(state.total) == 45.0 and int(state.count) == 10
+
+
+class TestRepositoryQuarantine:
+    def _saved_repo(self, tmp_path, monitor=None):
+        data = _data()
+        path = str(tmp_path / "history.json")
+        repo = FileSystemMetricsRepository(path, monitor=monitor)
+        ctx = AnalysisRunner.do_analysis_run(data, [Mean("x"), Sum("x")])
+        repo.save(ResultKey(1, {"run": "a"}), ctx)
+        repo.save(ResultKey(2, {"run": "b"}), ctx)
+        return repo, path
+
+    def test_entry_flip_quarantines_only_that_entry(self, tmp_path):
+        monitor = RunMonitor()
+        repo, path = self._saved_repo(tmp_path, monitor)
+        raw = open(path).read()
+        i = raw.index("Mean") + 1
+        open(path, "w").write(
+            raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+        )
+        results = repo._read_all()
+        assert len(results) == 1  # the clean entry keeps serving
+        assert monitor.corrupt_quarantined == 1
+        sidecars = os.listdir(path + ".quarantine")
+        assert len(sidecars) == 1 and sidecars[0].startswith("entry-")
+        # the preserved payload is the corrupt entry's JSON, forensically
+        # intact
+        preserved = json.load(
+            open(os.path.join(path + ".quarantine", sidecars[0]))
+        )
+        assert "checksum" in preserved
+
+    def test_structural_flip_quarantines_whole_file_and_recovers(
+        self, tmp_path
+    ):
+        repo, path = self._saved_repo(tmp_path)
+        raw = open(path).read()
+        open(path, "w").write(raw.replace("[", "", 1))  # torn JSON
+        assert repo._read_all() == []  # QUERIES: quarantined, not crashed
+        assert os.path.isdir(path + ".quarantine")
+        # a SAVE over the torn file refuses typed — rewriting would erase
+        # whatever valid entries the torn payload still holds
+        data = _data()
+        ctx = AnalysisRunner.do_analysis_run(data, [Mean("x")])
+        with pytest.raises(CorruptStateError, match="metrics-repository file"):
+            repo.save(ResultKey(3), ctx)
+        assert open(path).read() == raw.replace("[", "", 1)  # untouched
+        # the operator restores/clears the file (bytes live in the
+        # quarantine sidecar); saves work again
+        os.unlink(path)
+        repo.save(ResultKey(3), ctx)
+        assert len(repo._read_all()) == 1
+
+    def test_requarantine_is_idempotent(self, tmp_path):
+        """Content-addressed sidecars: re-reading the same unrepaired
+        corruption for weeks keeps ONE quarantine file, not one per read."""
+        repo, path = self._saved_repo(tmp_path)
+        raw = open(path).read()
+        i = raw.index("Mean") + 1
+        open(path, "w").write(
+            raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+        )
+        for _ in range(5):
+            assert len(repo._read_all()) == 1
+        assert len(os.listdir(path + ".quarantine")) == 1
+
+    def test_loader_queries_survive_corruption(self, tmp_path):
+        repo, path = self._saved_repo(tmp_path)
+        raw = open(path).read()
+        i = raw.index("Sum") + 1
+        open(path, "w").write(
+            raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+        )
+        frames = repo.load().get_success_metrics_as_data_frame()
+        assert len(frames) > 0  # the surviving entry's metrics
+
+    def test_legacy_unchecksummed_entry_loads(self, tmp_path):
+        """History written by a pre-checksum build (no per-entry checksum)
+        still deserializes."""
+        repo, path = self._saved_repo(tmp_path)
+        entries = json.load(open(path))
+        for e in entries:
+            e.pop("checksum")
+        open(path, "w").write(json.dumps(entries))
+        assert len(repo._read_all()) == 2
+
+
+class TestCheckpointCorruption:
+    def _run(self, data, analyzers, ckpt=None, monitor=None, placement=None):
+        return AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=1024, checkpointer=ckpt,
+            monitor=monitor, placement=placement,
+        )
+
+    @pytest.mark.parametrize("placement", [None, "host"])
+    def test_corrupt_checkpoint_falls_back_to_fresh_bitexact_fold(
+        self, tmp_path, placement, monkeypatch
+    ):
+        """ISSUE acceptance: a flipped byte in a checkpoint state blob
+        discards the resume point (typed, counted) and the fold restarts
+        from batch 0 — recomputed metrics EQUAL the uninterrupted run's,
+        on both tiers."""
+        if placement == "host":
+            from deequ_tpu.runners.engine import HOST_TIER_WORKERS_ENV
+
+            monkeypatch.setenv(HOST_TIER_WORKERS_ENV, "2")
+        # 80 batches: the host tier checkpoints on 32-batch chunk
+        # boundaries, so the interrupt must land well past one chunk
+        data = _data(rows=80 * 1024)
+        analyzers = [Completeness("x"), Mean("x"), Sum("x"), KLLSketch("x")]
+        uninterrupted = self._run(data, analyzers, placement=placement)
+        provider_dir = tmp_path / (placement or "device")
+        ckpt = IngestCheckpointer(
+            FileSystemStateProvider(str(provider_dir)), every=4
+        )
+        site, at = (
+            ("host_partial", 75) if placement == "host"
+            else ("device_update", 11)
+        )
+        with inject(FaultSpec(site, "interrupt", at=at)):
+            with pytest.raises(KeyboardInterrupt):
+                self._run(data, analyzers, ckpt=ckpt, placement=placement)
+        assert ckpt.saves  # a resume point exists
+        for path in glob.glob(str(provider_dir / "*-state.npz")):
+            _flip_byte(path)
+        monitor = RunMonitor()
+        resumed = self._run(
+            data, analyzers, ckpt=ckpt, monitor=monitor, placement=placement
+        )
+        assert monitor.resumed_at_batch is None  # fresh fold, not resume
+        assert ckpt.corrupt_discards >= 1
+        assert monitor.corrupt_quarantined >= 1
+        for a, metric in uninterrupted.metric_map.items():
+            got = resumed.metric_map[a]
+            if a.name == "KLLSketch":
+                assert repr(got.value.get().buckets) == repr(
+                    metric.value.get().buckets
+                )
+            else:
+                assert got.value.get() == metric.value.get(), a
+
+    def test_epoch_fence_refuses_stale_saves_and_completes(self, tmp_path):
+        """The watchdog-abandoned-zombie defense: a pass fenced by a newer
+        one (begin_run) can neither save a checkpoint nor clear the active
+        pass's meta — its writes no-op, counted."""
+        data = _data(rows=4 * 1024)
+        analyzers = [Mean("x"), Sum("x")]
+        ckpt = IngestCheckpointer(
+            FileSystemStateProvider(str(tmp_path)), every=2
+        )
+        stale = ckpt.begin_run()
+        current = ckpt.begin_run()  # fences `stale`
+        sp = FileSystemStateProvider(str(tmp_path / "src"))
+        AnalysisRunner.do_analysis_run(
+            data, analyzers, save_states_with=sp, batch_size=1024
+        )
+        real_states = [sp.load(a) for a in analyzers]
+        ckpt.save(2, 1024, 4096, analyzers, real_states, {}, epoch=stale)
+        assert ckpt.saves == [] and ckpt.fenced_saves == 1
+        ckpt.save(2, 1024, 4096, analyzers, real_states, {}, epoch=current)
+        assert ckpt.saves == [(2, 2)]
+        ckpt.complete(stale)  # must NOT clear the current resume point
+        assert ckpt.fenced_saves == 2
+        assert ckpt.load(1024, 4096, analyzers, []) is not None
+        ckpt.complete(current)
+        assert ckpt.load(1024, 4096, analyzers, []) is None
+
+    def test_engine_passes_fence_each_other(self, tmp_path):
+        """Each engine pass over a shared checkpointer bumps the epoch, so
+        a save issued with a pre-pass token is refused."""
+        data = _data(rows=8 * 1024)
+        analyzers = [Mean("x"), Sum("x")]
+        ckpt = IngestCheckpointer(
+            FileSystemStateProvider(str(tmp_path)), every=2
+        )
+        zombie_epoch = ckpt.begin_run()
+        AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=1024, checkpointer=ckpt
+        )
+        sp = FileSystemStateProvider(str(tmp_path / "src"))
+        AnalysisRunner.do_analysis_run(
+            data, analyzers, save_states_with=sp, batch_size=1024
+        )
+        ckpt.save(
+            6, 1024, 8192, analyzers, [sp.load(a) for a in analyzers], {},
+            epoch=zombie_epoch,
+        )
+        assert ckpt.fenced_saves == 1
+        # the completed run cleared its meta; the zombie could not
+        # resurrect a resume point
+        assert ckpt.load(1024, 8192, analyzers, []) is None
+
+    def test_tampered_meta_record_is_discarded(self, tmp_path):
+        data = _data(rows=8 * 1024)
+        analyzers = [Mean("x"), Sum("x")]
+        ckpt = IngestCheckpointer(
+            FileSystemStateProvider(str(tmp_path)), every=2
+        )
+        with inject(FaultSpec("device_update", "interrupt", at=5)):
+            with pytest.raises(KeyboardInterrupt):
+                self._run(data, analyzers, ckpt=ckpt)
+        meta_path = str(tmp_path / "ingest-checkpoint-meta.json")
+        meta = open(meta_path).read()
+        assert '"checksum"' in meta
+        # an off-by-one batch index would double-fold 2 batches on resume;
+        # the checksum catches the tamper and the fold starts fresh
+        tampered = meta.replace('"batch_index": 4', '"batch_index": 2')
+        assert tampered != meta
+        open(meta_path, "w").write(tampered)
+        monitor = RunMonitor()
+        result = self._run(data, analyzers, ckpt=ckpt, monitor=monitor)
+        assert monitor.resumed_at_batch is None
+        clean = AnalysisRunner.do_analysis_run(data, analyzers, batch_size=1024)
+        for a, metric in clean.metric_map.items():
+            assert result.metric_map[a].value.get() == metric.value.get()
+
+
+@pytest.mark.chaos
+class TestInjectedCorruption:
+    """The seeded `corrupt` fault kind at the load sites: the recovery
+    paths fire without any real bytes rotting."""
+
+    def test_state_load_corrupt_degrades_analyzer(self, tmp_path):
+        data = _data()
+        sp = FileSystemStateProvider(str(tmp_path))
+        battery = [Mean("x"), Sum("x")]
+        AnalysisRunner.do_analysis_run(data, battery, save_states_with=sp)
+        with inject(
+            FaultSpec("state_load", "corrupt", match="Mean")
+        ) as inj:
+            ctx = AnalysisRunner.do_analysis_run(
+                data, battery, aggregate_with=sp
+            )
+        assert inj.fired
+        assert ctx.metric_map[Mean("x")].value.is_failure
+        assert ctx.metric_map[Sum("x")].value.is_success
+
+    def test_repository_load_corrupt_quarantines_whole_file(self, tmp_path):
+        data = _data()
+        path = str(tmp_path / "history.json")
+        repo = FileSystemMetricsRepository(path)
+        ctx = AnalysisRunner.do_analysis_run(data, [Mean("x")])
+        repo.save(ResultKey(1), ctx)
+        with inject(FaultSpec("repository_load", "corrupt", at=1)) as inj:
+            assert repo._read_all() == []  # quarantined for THIS read
+        assert inj.fired
+        assert os.path.isdir(path + ".quarantine")
+        # the source file was preserved: the next (uninjected) read serves
+        assert len(repo._read_all()) == 1
+
+    def test_checkpoint_state_corrupt_resumes_fresh(self):
+        data = _data(rows=8 * 1024)
+        analyzers = [Mean("x"), Sum("x")]
+        provider = InMemoryStateProvider()
+        ckpt = IngestCheckpointer(provider, every=2)
+        with inject(FaultSpec("device_update", "interrupt", at=5)):
+            with pytest.raises(KeyboardInterrupt):
+                AnalysisRunner.do_analysis_run(
+                    data, analyzers, batch_size=1024, checkpointer=ckpt
+                )
+        assert ckpt.saves
+        # in-memory providers have no checksums (objects never serialize);
+        # the corrupt kind injected at state_load covers the FS ones above
+        monitor = RunMonitor()
+        resumed = AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=1024, checkpointer=ckpt,
+            monitor=monitor,
+        )
+        assert monitor.resumed_at_batch == 4
+        clean = AnalysisRunner.do_analysis_run(data, analyzers, batch_size=1024)
+        for a, metric in clean.metric_map.items():
+            assert resumed.metric_map[a].value.get() == metric.value.get()

@@ -379,15 +379,26 @@ class TestFormatVersioning:
         metric = DoubleMetric(Entity.COLUMN, "Mean", "x", Success(4.5))
         result = AnalysisResult(ResultKey(1700000000000, {"env": "t"}),
                                 AnalyzerContext({a: metric}))
+        # "checksum" is an OPTIONAL trailing member: old readers ignore
+        # unknown keys and the new reader accepts its absence (warn-once),
+        # so its addition does not bump the version. The pinned digest also
+        # freezes the checksum construction itself (canonical sorted-key
+        # JSON under xxhash64 seed 0x5EED).
         frozen = (
             '[{"formatVersion": 1, "resultKey": {"dataSetDate": 1700000000000, '
             '"tags": {"env": "t"}}, "analyzerContext": {"metricMap": '
             '[{"analyzer": {"analyzerName": "Mean", "column": "x", "where": null}, '
             '"metric": {"entity": "Column", "instance": "x", "name": "Mean", '
-            '"metricName": "DoubleMetric", "value": 4.5}}]}}]'
+            '"metricName": "DoubleMetric", "value": 4.5}}]}, '
+            '"checksum": "2ec68193ff205f29"}]'
         )
         assert serialize_results([result]) == frozen
         assert _json.loads(frozen)  # stays valid JSON
+        # the PRE-checksum v1 layout still deserializes (legacy history)
+        from deequ_tpu.repository.serde import deserialize_results
+
+        legacy = frozen.replace(', "checksum": "2ec68193ff205f29"', "")
+        assert len(deserialize_results(legacy)) == 1
 
     def test_v2_npz_layout_pinned(self, tmp_path):
         """Freeze the v2 .npz state layout for MeanState: leaf order is
@@ -399,8 +410,12 @@ class TestFormatVersioning:
         sp = FileSystemStateProvider(str(tmp_path))
         AnalysisRunner.do_analysis_run(data, [Mean("x")], save_states_with=sp)
         payload = np.load(next(iter(tmp_path.glob("*-state.npz"))))
+        # __checksum__ is an OPTIONAL member older readers ignore (their
+        # loaders only look for leaf*/__-prefixed names they know), so its
+        # addition does not bump the format version
         assert sorted(payload.files) == [
-            "__format_version__", "__state_type__", "__static__", "leaf0", "leaf1",
+            "__checksum__", "__format_version__", "__state_type__",
+            "__static__", "leaf0", "leaf1",
         ]
         assert int(payload["__format_version__"]) == 2
         assert str(payload["__state_type__"]) == "MeanState"

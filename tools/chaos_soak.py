@@ -73,6 +73,14 @@ def default_plan(seed: int):
         FaultSpec("worker", "worker_death", p=0.08, count=None),
         FaultSpec("stream_fold", "worker_death", p=0.10, count=None),
         FaultSpec("compile", "stall", p=0.2, count=2, delay_s=0.05),
+        # data-plane integrity faults: a corrupt persisted state blob at
+        # load time degrades exactly the analyzer that needed it; a
+        # drifted micro-batch is rejected BEFORE the fold (the parity
+        # invariant below proves rejected batches never half-fold)
+        FaultSpec("state_load", "corrupt", p=0.05, count=3),
+        FaultSpec("stream_fold", "drift", p=0.08, count=2),
+        # the repository drill's second read sees a whole-file corruption
+        FaultSpec("repository_load", "corrupt", at=2, count=1),
     ]
 
 
@@ -86,6 +94,9 @@ def run_soak(
 ) -> Dict:
     """Run the soak; returns the summary dict (see module docstring for
     the invariants it asserts)."""
+    import tempfile
+
+    from deequ_tpu.exceptions import SchemaDriftError
     from deequ_tpu.reliability import WorkerCrash, install, clear
     from deequ_tpu.runners.analysis_runner import collect_required_analyzers
     from deequ_tpu.service import ServiceError, VerificationService
@@ -100,12 +111,16 @@ def run_soak(
         "jobs": jobs, "stream_batches": stream_batches, "seed": seed,
         "succeeded": 0, "typed_failures": 0, "untyped_failures": 0,
         "unterminated": 0, "incomplete_metric_maps": 0,
-        "degraded_metrics": 0, "stream_folds_ok": 0,
+        "degraded_metrics": 0, "stream_folds_ok": 0, "drift_rejects": 0,
     }
+    state_root = tempfile.mkdtemp(prefix="chaos-soak-states-")
     try:
         with VerificationService(
             workers=workers, max_queue_depth=jobs + stream_batches + 8,
             background_warm=False,
+            # filesystem-backed session states: the streaming folds then
+            # exercise the checksummed state path and its state_load site
+            state_root=state_root,
         ) as service:
             handles = [
                 service.submit_verification(
@@ -122,6 +137,11 @@ def run_soak(
                 batch = _build_data(512, seed + 1000 + b)
                 try:
                     stream_results.append(session.ingest(batch, timeout=120))
+                except SchemaDriftError:
+                    # an injected drift fires BEFORE the fold: the batch is
+                    # rejected typed and must not count as folded
+                    summary["drift_rejects"] += 1
+                    stream_results.append(None)
                 except ServiceError:
                     stream_results.append(None)
             for handle in handles:
@@ -150,6 +170,7 @@ def run_soak(
             summary["stream_fold_parity"] = (
                 session.batches_ingested == summary["stream_folds_ok"]
             )
+            summary["repo_drill"] = _repository_drill(data, state_root)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -164,8 +185,51 @@ def run_soak(
         and summary["incomplete_metric_maps"] == 0
         and summary["stream_fold_parity"]
         and summary["succeeded"] + summary["typed_failures"] == jobs
+        and summary["repo_drill"]["ok"]
     )
     return summary
+
+
+def _repository_drill(data, tmpdir: str) -> Dict:
+    """Corruption drill on the FS metrics repository, run INSIDE the armed
+    fault plan: save two history entries, flip one byte inside one entry,
+    then read the history three times. A flipped entry is quarantined to
+    the ``.quarantine/`` sidecar while the other keeps serving; an
+    injected ``repository_load`` whole-file corruption (default plan,
+    at=2) quarantines the payload and serves an empty history for that
+    read only — the source file stays in place, so the NEXT read recovers
+    the surviving entry. No read ever crashes."""
+    import os
+
+    from deequ_tpu.analyzers import Completeness, Mean
+    from deequ_tpu.repository import ResultKey
+    from deequ_tpu.repository.fs import (
+        FileSystemMetricsRepository,
+        quarantined_total,
+    )
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+    path = os.path.join(tmpdir, "soak-repo.json")
+    repo = FileSystemMetricsRepository(path)
+    ctx = AnalysisRunner.do_analysis_run(data, [Mean("x"), Completeness("x")])
+    before = quarantined_total()
+    repo.save(ResultKey(1), ctx)
+    repo.save(ResultKey(2), ctx)
+    raw = open(path).read()
+    i = raw.index("Mean") + 1
+    open(path, "w").write(
+        raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+    )
+    survivors = [len(repo._read_all()) for _ in range(3)]
+    quarantined = quarantined_total() - before
+    return {
+        "survivors_per_read": survivors,
+        "quarantined": quarantined,
+        # the final read must serve the surviving entry (corruption is
+        # quarantined, never amplified), and at least one quarantine
+        # must have been recorded for the flipped entry
+        "ok": survivors[-1] == 1 and quarantined >= 1,
+    }
 
 
 def main(argv=None) -> int:
