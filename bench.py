@@ -29,6 +29,33 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def write_stage_trace(stage: str) -> None:
+    """Drain the flight-recorder ring into a per-stage Chrome trace
+    artifact (DEEQU_TPU_TRACE_DIR, default ./bench-traces): every bench
+    stage leaves its span tree behind, so a slow stage is explainable from
+    the artifact without re-running under a profiler. Draining keeps each
+    artifact scoped to its own stage."""
+    import os
+
+    try:
+        from deequ_tpu.observability import export as obs_export
+        from deequ_tpu.observability import recorder as obs_recorder
+        from deequ_tpu.observability import trace as obs_trace
+
+        if not obs_trace.enabled():
+            return
+        out_dir = os.environ.get("DEEQU_TPU_TRACE_DIR", "bench-traces")
+        spans = obs_recorder().drain()
+        if not spans:
+            return
+        path = obs_export.write_chrome_trace(
+            os.path.join(out_dir, f"bench-{stage}.trace.json"), spans
+        )
+        log(f"[{stage}] trace artifact: {path} ({len(spans)} spans)")
+    except Exception as exc:  # noqa: BLE001 - artifacts are advisory
+        log(f"[{stage}] trace artifact failed: {exc}")
+
+
 def monitor_phase_fields(mon) -> dict:
     """The per-stage observability fields the partial JSON records for every
     monitored stage (VERDICT r5 ask #1b): NEW program compiles this run
@@ -1001,6 +1028,7 @@ def main() -> None:
         stages[stage] = entry
         if status == "ok":
             completed.append(stage)
+        write_stage_trace(stage)
         line = dict(out)
         line["partial"] = True
         line["completed_stages"] = list(completed)

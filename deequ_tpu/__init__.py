@@ -11,6 +11,7 @@ follows.
 """
 
 from . import config  # noqa: F401  (sets up x64 before anything else)
+from . import observability  # noqa: F401  (tracing + flight recorder)
 from .checks import Check, CheckLevel, CheckStatus
 from .data import ColumnKind, Dataset, Schema
 from .repository import (
@@ -44,6 +45,7 @@ from .metrics import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "observability",
     "AnalysisResult",
     "AnomalyCheckConfig",
     "FileSystemMetricsRepository",

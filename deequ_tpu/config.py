@@ -89,3 +89,27 @@ def slim_fetch_enabled() -> bool:
 #: cancelled with a typed ScanStallError and fails over to the other tier
 #: exactly like a thrown device fault.
 SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
+
+
+# ---------------------------------------------------------------------------
+# Tracing / flight recorder (implemented in deequ_tpu.observability; the env
+# knobs are documented here with the other operator-facing switches)
+# ---------------------------------------------------------------------------
+
+# Single source of truth lives where the values are READ (the modules
+# below); re-exported here so every operator-facing knob is discoverable
+# from config:
+#
+# - DEEQU_TPU_TRACE: span tracing. Default ON ("1"/unset); "0" disables
+#   entirely; a float in (0, 1) samples that fraction of root traces
+#   deterministically (unparseable values warn once and keep the default).
+#   Measured overhead of default-on tracing is <2% on the bench scan stage
+#   (PERF.md "Tracing overhead").
+# - DEEQU_TPU_TRACE_RING: capacity of the flight-recorder ring of recent
+#   finished spans (default 4096) — what /trace serves and what
+#   typed-failure post-mortem dumps snapshot.
+# - DEEQU_TPU_FLIGHT_DIR: directory receiving flight-record JSONL
+#   artifacts dumped on typed failures (DeviceFailure / ScanStallError /
+#   CorruptStateError / SchemaDriftError). Unset = per-process temp dir.
+from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
+from .observability.trace import TRACE_ENV, TRACE_RING_ENV  # noqa: E402,F401

@@ -104,6 +104,18 @@ def checksum_json(obj: Dict[str, Any]) -> str:
     )
 
 
+def _raise_corrupt(kind: str, source: str, detail: str) -> None:
+    """Build, flight-record and raise the typed corruption error: every
+    checksum trip leaves a ``failure`` event on the current trace span and
+    a flight-recorder dump request, so a quarantine is explainable from the
+    trace artifact alone (see ``deequ_tpu.observability``)."""
+    exc = CorruptStateError(kind, source, detail)
+    from .observability import record_failure
+
+    record_failure(exc)
+    raise exc
+
+
 def verify_checksum(
     payload: bytes, expected: str, kind: str, source: str
 ) -> None:
@@ -112,7 +124,7 @@ def verify_checksum(
     identity ("what artifact, where")."""
     actual = checksum_bytes(payload)
     if actual != str(expected):
-        raise CorruptStateError(
+        _raise_corrupt(
             kind, source,
             f"checksum mismatch (stored {expected}, computed {actual})",
         )
@@ -123,7 +135,7 @@ def verify_json_checksum(
 ) -> None:
     actual = checksum_json(obj)
     if actual != str(expected):
-        raise CorruptStateError(
+        _raise_corrupt(
             kind, source,
             f"checksum mismatch (stored {expected}, computed {actual})",
         )

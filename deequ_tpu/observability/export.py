@@ -1,0 +1,116 @@
+"""Trace exporters: Chrome trace-event JSON (Perfetto-loadable) + JSONL.
+
+The Chrome trace-event format is the lowest-common-denominator viewer
+contract (``chrome://tracing``, Perfetto, speedscope all read it): one
+``traceEvents`` list of complete-duration events (``"ph": "X"``) with
+microsecond timestamps, plus instant events (``"ph": "i"``) for the typed
+span events (failovers, stalls, drift rejections). Span identity rides in
+``args`` (``trace_id``/``span_id``/``parent_id``) so tooling — including
+``tools/trace_summarize.py`` — can rebuild the tree from the artifact
+alone. The JSONL journal is the lossless form: one span dict per line,
+exactly what the flight recorder dumps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def _span_dict(span: Any) -> Dict[str, Any]:
+    return span if isinstance(span, dict) else span.to_dict()
+
+
+def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
+    """Chrome trace-event JSON object for ``spans`` (Span objects or their
+    dicts). Timestamps convert ns -> µs; unfinished spans export with zero
+    duration rather than being dropped (a crash artifact should still show
+    what was in flight)."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for raw in spans:
+        d = _span_dict(raw)
+        start_ns = d["start_ns"]
+        end_ns = d["end_ns"] if d["end_ns"] is not None else start_ns
+        args = dict(d.get("attrs") or {})
+        args.update(
+            trace_id=d["trace_id"], span_id=d["span_id"],
+            parent_id=d["parent_id"], status=d.get("status", "ok"),
+        )
+        events.append(
+            {
+                "name": d["name"],
+                "cat": d.get("kind", "span"),
+                "ph": "X",
+                "ts": start_ns / 1e3,
+                "dur": max(end_ns - start_ns, 0) / 1e3,
+                "pid": pid,
+                "tid": d.get("thread", 0),
+                "args": args,
+            }
+        )
+        for ev in d.get("events", ()):
+            ev_args = dict(ev.get("attrs") or {})
+            ev_args.update(trace_id=d["trace_id"], span_id=d["span_id"])
+            events.append(
+                {
+                    "name": ev["name"],
+                    "cat": "event",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": ev["ts_ns"] / 1e3,
+                    "pid": pid,
+                    "tid": d.get("thread", 0),
+                    "args": ev_args,
+                }
+            )
+    from .trace import EPOCH_ANCHOR_S
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        # ts values are process-monotonic µs (perf_counter); the anchor
+        # maps them to wall clock for log/Prometheus correlation:
+        # absolute seconds ~= epoch_anchor_s + ts / 1e6
+        "otherData": {"epoch_anchor_s": EPOCH_ANCHOR_S},
+    }
+
+
+def chrome_trace_text(spans: Optional[Iterable[Any]] = None) -> str:
+    if spans is None:
+        from .recorder import recorder
+
+        spans = recorder().spans()
+    return json.dumps(spans_to_chrome(spans))
+
+
+def spans_to_jsonl(spans: Optional[Iterable[Any]] = None) -> str:
+    if spans is None:
+        from .recorder import recorder
+
+        spans = recorder().spans()
+    return "".join(json.dumps(_span_dict(s)) + "\n" for s in spans)
+
+
+def write_chrome_trace(path: str, spans: Optional[Iterable[Any]] = None) -> str:
+    """Write the Chrome artifact (default: the flight-recorder ring);
+    returns ``path``."""
+    text = chrome_trace_text(spans)
+    _write_atomic(path, text)
+    return path
+
+
+def write_jsonl(path: str, spans: Optional[Iterable[Any]] = None) -> str:
+    _write_atomic(path, spans_to_jsonl(spans))
+    return path
+
+
+def _write_atomic(path: str, text: str) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)

@@ -218,9 +218,14 @@ class IngestCheckpointer:
             try:
                 meta = json.loads(raw)
             except ValueError as exc:
-                raise CorruptStateError(
+                from ..observability import record_failure
+
+                torn = CorruptStateError(
                     "ingest-checkpoint meta", path, str(exc)
-                ) from exc
+                )
+                torn.__cause__ = exc
+                record_failure(torn)
+                raise torn
             if meta.get("cleared"):
                 return None
             if "checksum" in meta:
@@ -321,6 +326,12 @@ class IngestCheckpointer:
             self.corrupt_discards += 1
             if monitor is not None:
                 monitor.bump("corrupt_quarantined")
+            from ..observability import trace as _trace
+
+            _trace.add_event(
+                "checkpoint_discarded", what=what,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
             _logger.warning(
                 "ingest checkpoint discarded (%s is corrupt; restarting "
                 "the fold from batch 0): %s", what, exc,

@@ -36,6 +36,9 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..observability import record_failure
+from ..observability import trace as _trace
+
 _logger = logging.getLogger(__name__)
 
 #: batch-size floor below which OOM bisection gives up (padding dominates)
@@ -171,6 +174,12 @@ def run_scan_resilient(
         for analyzer in part:
             outcome.errors[analyzer] = exc
             monitor.note_degraded(repr(analyzer))
+        if part:
+            _trace.add_event(
+                "analyzers_degraded", count=len(part),
+                analyzers=[repr(a) for a in part[:8]],
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
 
     def run_partition(part: Tuple):
         """Run one partition, bisecting on failure. Returns (fully_failed,
@@ -200,6 +209,10 @@ def run_scan_resilient(
                     "fused battery of %d analyzers failed (%s: %s); "
                     "bisecting to isolate", len(part), type(exc).__name__, exc,
                 )
+            _trace.add_event(
+                "isolation_bisect", partition=len(part),
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
             monitor.bump("isolation_reruns")
             mid = len(part) // 2
             left, right = part[:mid], part[mid:]
@@ -286,6 +299,8 @@ def _attempt_tiered(
                 oom_left -= 1
                 bs //= 2
                 monitor.bump("batch_bisections")
+                record_failure(exc)
+                _trace.add_event("oom_bisect", batch_size=bs)
                 _logger.warning(
                     "device OOM (%s); bisecting batch size to %d", exc, bs
                 )
@@ -296,6 +311,15 @@ def _attempt_tiered(
             if kind in ("oom", "device") and placement_now != "host" and host_capable:
                 monitor.bump("device_failovers")
                 monitor.note_degraded(f"tier:device->{kind}")
+                # the typed failure event + flight-recorder dump, then the
+                # failover hop itself — a degraded run's trace shows the
+                # failed device pass, the exception, and the host re-pass
+                # as one connected tree
+                record_failure(exc)
+                _trace.add_event(
+                    "device_failover", to="host", kind=kind,
+                    analyzers=len(part),
+                )
                 _logger.warning(
                     "device tier failed (%s: %s); failing battery of %d "
                     "over to the host ingest tier",

@@ -150,12 +150,21 @@ def run_with_deadline(
     stays a daemon; its eventual return value is discarded) and raise
     :class:`ScanStallError`. On completion: return/raise exactly what
     ``fn`` did."""
+    from ..observability import record_failure
+    from ..observability import trace as _trace
+
     box: Dict[str, object] = {}
     done = threading.Event()
+    # the pass body runs on a daemon thread: carry the caller's trace
+    # context over so the pass's spans stay in the caller's tree (an
+    # abandoned zombie keeps appending to the SAME trace, which is exactly
+    # what a post-mortem wants to see)
+    ctx = _trace.capture()
 
     def body() -> None:
         try:
-            box["value"] = fn()
+            with _trace.attach(ctx):
+                box["value"] = fn()
         except BaseException as exc:  # noqa: BLE001 - re-raised in caller
             box["error"] = exc
         finally:
@@ -176,7 +185,12 @@ def run_with_deadline(
                 # battery to the host tier because the HOST hung would
                 # probation it onto the sick tier
                 monitor.bump("device_stalls")
-        raise ScanStallError(site, deadline_s, waited)
+        stall = ScanStallError(site, deadline_s, waited)
+        _trace.add_event(
+            "scan_stall", site=site, deadline_s=deadline_s, waited_s=waited
+        )
+        record_failure(stall)
+        raise stall
     if "error" in box:
         raise box["error"]
     return box["value"]

@@ -118,6 +118,12 @@ class FileSystemMetricsRepository(MetricsRepository):
                 self.monitor.bump("corrupt_quarantined")
             except Exception:  # noqa: BLE001 - observability only
                 pass
+        from ..observability import trace as _trace
+
+        _trace.add_event(
+            "repository_quarantined", kind=kind, where=where,
+            reason=str(reason)[:200],
+        )
         _logger.warning(
             "quarantined corrupt repository %s from %s to %s: %s",
             kind, self.path, where, reason,

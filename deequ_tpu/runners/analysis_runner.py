@@ -55,7 +55,22 @@ class AnalysisRunner:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def do_analysis_run(
+    def do_analysis_run(data: Dataset, analyzers: Sequence[Analyzer], **kwargs) -> AnalyzerContext:
+        """Tracing shell around :meth:`_do_analysis_run`: every pass this
+        run triggers — the primary fused scan, bisection re-passes, tier
+        failovers — nests under ONE ``analysis_run`` span, so a degraded
+        run reads as a connected tree (see ``deequ_tpu.observability``)."""
+        if len(analyzers) == 0:
+            return AnalyzerContext.empty()
+        from ..observability import trace as _trace
+
+        with _trace.span(
+            "analysis_run", kind="analysis", analyzers=len(analyzers)
+        ):
+            return AnalysisRunner._do_analysis_run(data, analyzers, **kwargs)
+
+    @staticmethod
+    def _do_analysis_run(
         data: Dataset,
         analyzers: Sequence[Analyzer],
         *,
@@ -288,68 +303,76 @@ class AnalysisRunner:
 
             # scanning analyzers: load old state -> merge -> persist -> metric
             # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
-            for a in scanning:
-                if a in outcome.states:
-                    metrics[a] = _finalize(
-                        a, outcome.states[a], aggregate_with, save_states_with
-                    )
-                else:
-                    metrics[a] = a.to_failure_metric(outcome.errors[a])
-            device_freq_states = {
-                cols: outcome.states.get(scan)
-                for cols, scan in device_freq.items()
-            }
+            # — a monitored phase, so state-merge/persist/metric cost is
+            # attributable (and span-backed) like every engine phase
+            with run_monitor.timed("metric_derivation"):
+                for a in scanning:
+                    if a in outcome.states:
+                        metrics[a] = _finalize(
+                            a, outcome.states[a], aggregate_with, save_states_with
+                        )
+                    else:
+                        metrics[a] = a.to_failure_metric(outcome.errors[a])
+                device_freq_states = {
+                    cols: outcome.states.get(scan)
+                    for cols, scan in device_freq.items()
+                }
 
-            def shared_frequencies(cols):
-                """The grouping state for ``cols``, or the typed error that
-                took its producer down (device scan or host accumulator)."""
-                if cols in device_freq:
-                    scan = device_freq[cols]
-                    if device_freq_states[cols] is None:
-                        return None, outcome.errors[scan]
-                    return (
-                        scan.to_frequencies(
-                            device_freq_states[cols], device_dicts[cols]
-                        ),
-                        None,
-                    )
-                key = ("__grouping__", cols)
-                if key in outcome.host_errors:
-                    return None, outcome.host_errors[key]
-                return outcome.host_states[key], None
+                def shared_frequencies(cols):
+                    """The grouping state for ``cols``, or the typed error
+                    that took its producer down (device scan or host
+                    accumulator)."""
+                    if cols in device_freq:
+                        scan = device_freq[cols]
+                        if device_freq_states[cols] is None:
+                            return None, outcome.errors[scan]
+                        return (
+                            scan.to_frequencies(
+                                device_freq_states[cols], device_dicts[cols]
+                            ),
+                            None,
+                        )
+                    key = ("__grouping__", cols)
+                    if key in outcome.host_errors:
+                        return None, outcome.host_errors[key]
+                    return outcome.host_states[key], None
 
-            for cols, members in grouping_sets.items():
-                shared, err = shared_frequencies(cols)
-                for a in members:
-                    if err is not None:
-                        metrics[a] = a.to_failure_metric(err)
+                for cols, members in grouping_sets.items():
+                    shared, err = shared_frequencies(cols)
+                    for a in members:
+                        if err is not None:
+                            metrics[a] = a.to_failure_metric(err)
+                        else:
+                            metrics[a] = _finalize(
+                                a, shared, aggregate_with, save_states_with
+                            )
+                for a in host_accum:
+                    if a in outcome.host_errors:
+                        metrics[a] = a.to_failure_metric(outcome.host_errors[a])
                     else:
                         metrics[a] = _finalize(
-                            a, shared, aggregate_with, save_states_with
+                            a, outcome.host_states[a], aggregate_with,
+                            save_states_with,
                         )
-            for a in host_accum:
-                if a in outcome.host_errors:
-                    metrics[a] = a.to_failure_metric(outcome.host_errors[a])
-                else:
-                    metrics[a] = _finalize(
-                        a, outcome.host_states[a], aggregate_with,
-                        save_states_with,
-                    )
-            from ..analyzers.grouping import device_counts_to_histogram_frequencies
-
-            for a in device_hist:
-                cols = (a.column,)
-                if device_freq_states[cols] is None:
-                    metrics[a] = a.to_failure_metric(
-                        outcome.errors[device_freq[cols]]
-                    )
-                    continue
-                shared = device_counts_to_histogram_frequencies(
-                    device_freq[cols],
-                    device_freq_states[cols],
-                    device_dicts[cols],
+                from ..analyzers.grouping import (
+                    device_counts_to_histogram_frequencies,
                 )
-                metrics[a] = _finalize(a, shared, aggregate_with, save_states_with)
+
+                for a in device_hist:
+                    cols = (a.column,)
+                    if device_freq_states[cols] is None:
+                        metrics[a] = a.to_failure_metric(
+                            outcome.errors[device_freq[cols]]
+                        )
+                        continue
+                    shared = device_counts_to_histogram_frequencies(
+                        device_freq[cols],
+                        device_freq_states[cols],
+                        device_dicts[cols],
+                    )
+                    metrics[a] = _finalize(
+                        a, shared, aggregate_with, save_states_with
+                    )
         for a in others:
             metrics[a] = a.to_failure_metric(
                 MetricCalculationException(f"No execution strategy for analyzer {a}")

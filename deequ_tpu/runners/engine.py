@@ -28,6 +28,7 @@ from ..analyzers.base import ScanShareableAnalyzer
 from ..analyzers.grouping import FrequenciesAndNumRows, GroupingAnalyzer
 from ..config import DEFAULT_BATCH_SIZE
 from ..data import Dataset
+from ..observability import trace as _trace
 from ..reliability.faults import fault_point
 from .features import FeatureBuilder
 
@@ -78,6 +79,25 @@ class RunMonitor:
     #: placement router's probation signal (a host-tier hang must not pin
     #: the battery onto the tier that hung)
     device_stalls: int = 0
+    #: per-analyzer cost attribution (seconds, keyed by repr(analyzer)):
+    #: each signature bundle's measured compile+dispatch wall time split
+    #: evenly across its REAL slots (pad slots re-fold a duplicate and
+    #: charge nothing). Shares sum to ``bundle_dispatch_seconds`` exactly,
+    #: so "what did analyzer X cost this run" is answerable even though
+    #: bundling makes individual programs invisible. Dispatch is async:
+    #: what a share measures is enqueue time plus, on a bundle's FIRST
+    #: dispatch, the synchronous trace+XLA-compile it pays — the periodic
+    #: solo-timing probe (``cost_probes``) adds synchronized samples where
+    #: the bundle's true per-batch execution time is captured too.
+    cost_by_analyzer: Dict[str, float] = field(default_factory=dict)
+    #: total measured per-bundle dispatch wall seconds (the attribution
+    #: denominator: sum(cost_by_analyzer.values()) == this, within float
+    #: rounding)
+    bundle_dispatch_seconds: float = 0.0
+    #: synchronized solo-timing probes taken (every _COST_PROBE_EVERY
+    #: batches a bundle dispatch is bracketed by block_until_ready, so its
+    #: measured time is true execution, not enqueue)
+    cost_probes: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -97,6 +117,9 @@ class RunMonitor:
         self.corrupt_quarantined = 0
         self.stalls = 0
         self.device_stalls = 0
+        self.cost_by_analyzer = {}
+        self.bundle_dispatch_seconds = 0.0
+        self.cost_probes = 0
 
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
@@ -136,7 +159,13 @@ _CACHE_BYPASS = _threading.local()
 
 
 class _PhaseTimer:
-    __slots__ = ("monitor", "phase", "t0")
+    """Span-backed phase timer: the measured interval both accumulates into
+    ``phase_seconds`` (unchanged numbers, now derived from the same ns
+    clock) and, when the calling thread carries a trace context, publishes
+    as a finished child span — so a trace's phase durations can never
+    disagree with the monitor's."""
+
+    __slots__ = ("monitor", "phase", "t0_ns")
 
     def __init__(self, monitor: RunMonitor, phase: str):
         self.monitor = monitor
@@ -145,13 +174,15 @@ class _PhaseTimer:
     def __enter__(self):
         import time
 
-        self.t0 = time.perf_counter()
+        self.t0_ns = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
         import time
 
-        self.monitor.add_phase_time(self.phase, time.perf_counter() - self.t0)
+        end_ns = time.perf_counter_ns()
+        self.monitor.add_phase_time(self.phase, (end_ns - self.t0_ns) / 1e9)
+        _trace.record_phase(self.phase, self.t0_ns, end_ns)
         return False
 
 
@@ -337,6 +368,52 @@ _BUNDLE_PROGRAM_CACHE = _BoundedLRU(512)
 
 _SCAN_SIG_CACHE = _BoundedLRU(4096)
 
+#: batches between synchronized cost-attribution probes: the probed batch's
+#: bundle dispatches are bracketed with block_until_ready so their measured
+#: time is true execution (async dispatch otherwise measures enqueue). The
+#: first probe lands on batch index 1 — batch 0 pays any cold compile and
+#: would conflate compile with execution.
+_COST_PROBE_EVERY = 64
+
+
+class _CostLedger:
+    """PASS-LOCAL per-analyzer cost accumulation. Two reasons it exists
+    instead of writing straight to the RunMonitor:
+
+    - **Hot-path cost.** Attribution runs per bundle per batch; a local
+      dict accumulate is lock-free and uses the bundle programs'
+      PRECOMPUTED repr strings, with ONE locked flush per pass.
+    - **Zombie-pass hygiene.** A watchdog-abandoned pass keeps dispatching
+      on its daemon thread while the failover re-pass runs against the
+      SAME monitor; flushing only at pass completion — and only when the
+      engine has not marked the pass cancelled — keeps an abandoned pass's
+      costs out of ``cost_by_analyzer`` (the attribution analog of the
+      rate tracker's contamination guard)."""
+
+    __slots__ = ("by_key", "total", "probes")
+
+    def __init__(self):
+        self.by_key: Dict[str, float] = {}
+        self.total = 0.0
+        self.probes = 0
+
+    def add_bundle(self, slot_reprs, seconds: float) -> None:
+        self.total += seconds
+        share = seconds / len(slot_reprs)
+        by_key = self.by_key
+        for key in slot_reprs:
+            by_key[key] = by_key.get(key, 0.0) + share
+
+    def flush(self, monitor: RunMonitor) -> None:
+        if not self.by_key and not self.probes:
+            return
+        with _MONITOR_LOCK:
+            costs = monitor.cost_by_analyzer
+            for key, seconds in self.by_key.items():
+                costs[key] = costs.get(key, 0.0) + seconds
+            monitor.bundle_dispatch_seconds += self.total
+            monitor.cost_probes += self.probes
+
 
 def _scan_signature(a: ScanShareableAnalyzer) -> Tuple:
     """Program-identity key of an analyzer's fused-scan update: the ingest
@@ -476,15 +553,53 @@ class BundledScanProgram:
             ]
             for idxs, _ in self._bundles
         ]
+        #: per-bundle repr strings of the REAL slots — precomputed so cost
+        #: attribution never builds repr() on the dispatch hot path
+        self._slot_reprs = [
+            [repr(analyzers[i]) for i in idxs[:n_real]]
+            for idxs, n_real in self._bundles
+        ]
 
     def init_carry(self):
         return tuple(prog.init_carry() for prog in self._programs)
 
-    def __call__(self, carry, features: Dict[str, jax.Array]):
+    def __call__(
+        self,
+        carry,
+        features: Dict[str, jax.Array],
+        ledger: Optional[_CostLedger] = None,
+        probe: bool = False,
+    ):
+        """Dispatch one batch. With ``ledger`` (a pass-local
+        :class:`_CostLedger`), each bundle's dispatch wall time is measured
+        and attributed evenly across its REAL slots; async dispatch means
+        the share normally measures enqueue + (on the first dispatch) the
+        synchronous trace/XLA compile. ``probe=True`` brackets each bundle
+        with ``block_until_ready`` so this batch's measurement is TRUE
+        execution time — the engine schedules one probe every
+        ``_COST_PROBE_EVERY`` batches, bounding the sync overhead."""
+        import time as _time
+
         out = []
-        for c, prog, keys in zip(carry, self._programs, self._slot_keys):
+        for c, prog, keys, reprs in zip(
+            carry, self._programs, self._slot_keys, self._slot_reprs
+        ):
             slots = tuple(tuple(features[k] for k in slot) for slot in keys)
-            out.append(prog.call_with_slots(c, slots))
+            if ledger is None:
+                out.append(prog.call_with_slots(c, slots))
+                continue
+            if probe:
+                jax.block_until_ready(jax.tree_util.tree_leaves(c))
+            t0 = _time.perf_counter()
+            result = prog.call_with_slots(c, slots)
+            if probe:
+                jax.block_until_ready(jax.tree_util.tree_leaves(result))
+            out.append(result)
+            ledger.add_bundle(reprs, _time.perf_counter() - t0)
+        if probe and ledger is not None and self._programs:
+            # one probe per probed BATCH (the documented unit), however
+            # many bundles the battery spans
+            ledger.probes += 1
         self.executed = True
         return tuple(out)
 
@@ -1350,6 +1465,11 @@ class ScanEngine:
 
         self.scan_analyzers = list(scan_analyzers)
         self.monitor = monitor or RunMonitor()
+        #: set when the watchdog abandons this engine's pass: the zombie
+        #: thread checks it before flushing its cost ledger, so an
+        #: abandoned pass's attribution never contaminates the monitor the
+        #: failover re-pass (a NEW engine) is reporting into
+        self._cancelled = _threading.Event()
         self.mesh = sharding  # a jax.sharding.Mesh -> row-sharded GSPMD scan
         self.placement = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
         self.builder = FeatureBuilder(
@@ -1467,25 +1587,45 @@ class ScanEngine:
 
             batches_before = self.monitor.batches
             t0 = time.perf_counter()
-            if deadline is None:
-                result = self._run_inner(
-                    data, batch_size, host_accumulators, host_update_fns,
-                    columns, checkpointer, slim_fetch,
-                )
-            else:
-                # the pass body moves to the watchdog's worker thread; the
-                # per-thread cache-bypass flag (background warm runs) must
-                # move with it or a warm sample would enter the budget
-                def pass_body():
-                    _CACHE_BYPASS.active = bypass
-                    return self._run_inner(
+            with _trace.span(
+                "engine_pass", kind="engine", tier=tier, rows=n_rows,
+                batches=n_batches, analyzers=len(self.scan_analyzers),
+            ):
+                if deadline is None:
+                    result = self._run_inner(
                         data, batch_size, host_accumulators, host_update_fns,
                         columns, checkpointer, slim_fetch,
                     )
+                else:
+                    # the pass body moves to the watchdog's worker thread;
+                    # the per-thread cache-bypass flag (background warm
+                    # runs) and the trace context must move with it, or a
+                    # warm sample would enter the budget and the pass's
+                    # phases would orphan into a fresh trace
+                    ctx = _trace.capture()
 
-                result = run_with_deadline(
-                    pass_body, deadline, self.monitor, tier
-                )
+                    def pass_body():
+                        _CACHE_BYPASS.active = bypass
+                        with _trace.attach(ctx):
+                            return self._run_inner(
+                                data, batch_size, host_accumulators,
+                                host_update_fns, columns, checkpointer,
+                                slim_fetch,
+                            )
+
+                    from ..exceptions import ScanStallError
+
+                    try:
+                        result = run_with_deadline(
+                            pass_body, deadline, self.monitor, tier
+                        )
+                    except ScanStallError:
+                        # the abandoned zombie must stop reporting costs
+                        # into this monitor (best-effort: a flush already
+                        # in flight at this instant is the same bounded
+                        # race the rate tracker tolerates)
+                        self._cancelled.set()
+                        raise
             # only COMPLETED passes teach the rate tracker, and only
             # REPRESENTATIVE ones: background warm runs (1-row samples
             # under the cache bypass) and the batches a resume skipped
@@ -1598,8 +1738,16 @@ class ScanEngine:
         import itertools
 
         idx_counter = itertools.count()
+        # the prefetch worker builds features on its own thread: carry the
+        # trace context over so feature_build/device_feed phase spans stay
+        # children of this pass instead of orphaning
+        trace_ctx = _trace.capture()
 
         def produce():
+            with _trace.attach(trace_ctx):
+                return produce_inner()
+
+        def produce_inner():
             index = next(idx_counter)
             try:
                 batch = next(batches)
@@ -1621,6 +1769,7 @@ class ScanEngine:
             return batch, self._prepare(batch)
 
         carry = self._update.init_carry() if self._update is not None else None
+        cost_ledger = _CostLedger()
         folded = 0
         if resume is not None:
             # re-enter the fold at the checkpoint: restore the carry from
@@ -1659,7 +1808,10 @@ class ScanEngine:
                 if features is not None:
                     fault_point("device_update", tag=str(folded + 1))
                     with monitor.timed("device_dispatch"):
-                        carry = self._update(carry, features)
+                        carry = self._update(
+                            carry, features, ledger=cost_ledger,
+                            probe=(folded % _COST_PROBE_EVERY == 1),
+                        )
                     monitor.bump("device_updates")
                 with monitor.timed("host_accumulators"):
                     for key, fn in update_fns.items():
@@ -1687,6 +1839,8 @@ class ScanEngine:
                 states,
                 analyzers=tuple(self.scan_analyzers) if slim_fetch else None,
             )
+        if not self._cancelled.is_set():
+            cost_ledger.flush(monitor)
         return host_side, host_states
 
     def _run_host_tier(
@@ -1764,13 +1918,29 @@ class ScanEngine:
         # dictionary entries already seen) but never across passes
         run_token = object()
 
+        # host partials run on a pool spanning all cores: carry the trace
+        # context so host_partials phase spans stay in this pass's tree
+        trace_ctx = _trace.capture()
+        cost_ledger = _CostLedger()
+        # repr strings precomputed once per pass (never on the fold path)
+        bundle_reprs = (
+            [[repr(analyzers[i]) for i in b[:n_real_b]]
+             for (b, n_real_b), _ in program]
+            if program is not None else []
+        )
+
         def compute_partial(index: int, batch) -> Tuple:
-            fault_point("host_partial", tag=str(index))
-            with monitor.timed("host_partials"):
-                ctx = HostBatchContext(batch, batch_index=index, run_token=run_token)
-                return tuple(a.host_partial(ctx) for a in analyzers)
+            with _trace.attach(trace_ctx):
+                fault_point("host_partial", tag=str(index))
+                with monitor.timed("host_partials"):
+                    ctx = HostBatchContext(
+                        batch, batch_index=index, run_token=run_token
+                    )
+                    return tuple(a.host_partial(ctx) for a in analyzers)
 
         def fold_chunk(states, group: List[Tuple], n_real: int):
+            import time as _time
+
             fault_point("ingest_fold")
             with monitor.timed("ingest_fold"):
                 stacked = tuple(
@@ -1790,14 +1960,18 @@ class ScanEngine:
                 # per-bundle async dispatches; states reassemble in the
                 # original analyzer order. Pad slots (positions >= n_real
                 # in a tail bundle) re-fold an analyzer another bundle owns
-                # and their outputs are discarded.
+                # and their outputs are discarded. Each bundle's dispatch
+                # wall time is attributed evenly across its real slots —
+                # the host-tier arm of per-analyzer cost attribution.
                 out = list(states)
-                for (b, n_real_b), prog in program:
+                for ((b, n_real_b), prog), reprs in zip(program, bundle_reprs):
+                    t0 = _time.perf_counter()
                     sub = prog(
                         tuple(states[i] for i in b),
                         flags,
                         tuple(stacked[i] for i in b),
                     )
+                    cost_ledger.add_bundle(reprs, _time.perf_counter() - t0)
                     for j in range(n_real_b):
                         out[b[j]] = sub[j]
                 return tuple(out)
@@ -1909,4 +2083,6 @@ class ScanEngine:
             host_side = _fetch_states_packed(
                 states, analyzers=analyzers if slim_fetch else None
             )
+        if not self._cancelled.is_set():
+            cost_ledger.flush(monitor)
         return host_side, host_states

@@ -44,6 +44,11 @@ def _escape_label_value(value: str) -> str:
     )
 
 
+def _escape_help(text: str) -> str:
+    """Prometheus HELP-line escaping (backslash and newline only)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _render_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
@@ -66,6 +71,11 @@ class ServiceMetrics:
         self._counters: Dict[_LabelKey, float] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._help: Dict[str, str] = {}
+        self.describe(
+            "deequ_service_export_errors_total",
+            "Gauge callables that raised during an exposition; the series "
+            "was skipped so the rest of the scrape kept serving.",
+        )
 
     # -- registration / update ----------------------------------------------
 
@@ -101,14 +111,23 @@ class ServiceMetrics:
     # -- export --------------------------------------------------------------
 
     def _eval_gauges(self) -> Dict[str, float]:
+        """Evaluate every registered gauge. A RAISING gauge must not kill
+        the whole exposition: its series is SKIPPED for this scrape (a NaN
+        placeholder would poison recording rules; absence is the honest
+        signal) and the failure is counted under
+        ``deequ_service_export_errors_total{gauge=...}`` so the breakage
+        itself is monitorable."""
         out = {}
         with self._lock:  # snapshot: a scrape must not race set_gauge_fn
             gauges = list(self._gauges.items())
+        failed = []
         for name, fn in gauges:
             try:
                 out[name] = float(fn())
-            except Exception:  # noqa: BLE001 - a dead gauge must not kill export
-                out[name] = float("nan")
+            except Exception:  # noqa: BLE001 - skip, count, keep serving
+                failed.append(name)
+        for name in failed:
+            self.inc("deequ_service_export_errors_total", gauge=name)
         return out
 
     def json_snapshot(self) -> Dict[str, Any]:
@@ -117,6 +136,10 @@ class ServiceMetrics:
         NaN token would make the whole payload unparseable to strict JSON
         parsers."""
         import math
+        # evaluate gauges BEFORE snapshotting counters: a raising gauge
+        # increments the export-error counter, and this snapshot should
+        # already show that increment (mirrors prometheus_text)
+        gauge_values = self._eval_gauges()
         with self._lock:
             counters = dict(self._counters)
         series: Dict[str, Any] = {}
@@ -133,7 +156,7 @@ class ServiceMetrics:
                 series[name] = value
         gauges = {
             name: (value if math.isfinite(value) else None)
-            for name, value in self._eval_gauges().items()
+            for name, value in gauge_values.items()
         }
         return {"counters": series, "gauges": gauges}
 
@@ -141,22 +164,31 @@ class ServiceMetrics:
         return json.dumps(self.json_snapshot(), sort_keys=True)
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. EVERY series gets its
+        ``# HELP`` and ``# TYPE`` lines — scrapers and ``promtool check
+        metrics`` expect them; an undescribed series gets a generated
+        placeholder rather than a bare sample."""
+        # evaluate gauges FIRST: a raising gauge increments the export-error
+        # counter, and this scrape should already show that increment
+        gauges = self._eval_gauges()
         with self._lock:
             counters = dict(self._counters)
             help_texts = dict(self._help)
+
+        def help_line(name: str) -> str:
+            text = help_texts.get(name, f"{name} (no description registered).")
+            return f"# HELP {name} {_escape_help(text)}"
+
         lines = []
         seen_header = set()
         for (name, labels), value in sorted(counters.items()):
             if name not in seen_header:
                 seen_header.add(name)
-                if name in help_texts:
-                    lines.append(f"# HELP {name} {help_texts[name]}")
+                lines.append(help_line(name))
                 lines.append(f"# TYPE {name} counter")
             lines.append(f"{name}{_render_labels(labels)} {_format(value)}")
-        for name, value in sorted(self._eval_gauges().items()):
-            if name in help_texts:
-                lines.append(f"# HELP {name} {help_texts[name]}")
+        for name, value in sorted(gauges.items()):
+            lines.append(help_line(name))
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_format(value)}")
         return "\n".join(lines) + "\n"
@@ -175,9 +207,11 @@ def _format(value: float) -> str:
 
 
 class MetricsExporter:
-    """Serves ``/metrics`` (Prometheus text) and ``/metrics.json`` from a
-    daemon thread. Binds to an ephemeral port by default (``port=0``); the
-    bound port is on ``.port``."""
+    """Serves ``/metrics`` (Prometheus text), ``/metrics.json``, and the
+    trace plane — ``/trace`` (Chrome trace-event / Perfetto-loadable JSON
+    of the flight-recorder ring) and ``/trace.jsonl`` (the span journal) —
+    from a daemon thread. Binds to an ephemeral port by default
+    (``port=0``); the bound port is on ``.port``."""
 
     def __init__(
         self, metrics: ServiceMetrics, host: str = "127.0.0.1", port: int = 0
@@ -194,6 +228,16 @@ class MetricsExporter:
                 elif self.path.startswith("/metrics"):
                     body = plane.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/trace.jsonl"):
+                    from ..observability import export as _obs_export
+
+                    body = _obs_export.spans_to_jsonl().encode()
+                    ctype = "application/jsonl"
+                elif self.path.startswith("/trace"):
+                    from ..observability import export as _obs_export
+
+                    body = _obs_export.chrome_trace_text().encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return

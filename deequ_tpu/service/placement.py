@@ -194,6 +194,8 @@ class PlacementRouter:
         program) or, absent one, a program registration — so the cold
         window closes after roughly one compile regardless of arrival
         rate."""
+        from ..observability import trace as _trace
+
         if not signature:
             return None
         with self._lock:
@@ -204,11 +206,20 @@ class PlacementRouter:
                 # let it try the device again
                 self._device_suspect[signature] = probation - 1
                 self.metrics.inc("deequ_service_suspect_host_routes_total")
+                _trace.add_event(
+                    "placement_routed", decision="host", reason="probation",
+                    probation_left=probation - 1,
+                )
                 return "host"
         if self.is_warm(signature):  # .get inside refreshes LRU recency
             self.metrics.inc("deequ_service_placement_cache_hits_total")
+            _trace.add_event("placement_routed", decision="auto", reason="warm")
             return None
         self.metrics.inc("deequ_service_placement_cache_misses_total")
+        _trace.add_event(
+            "placement_routed", decision="host", reason="cold",
+            background_warm=warm is not None and self._warmer is not None,
+        )
         if warm is not None and self._warmer is not None:
             self._warm_in_background(signature, warm)
         elif self._warmer is None:

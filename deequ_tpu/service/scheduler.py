@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
+from ..observability import trace as _trace
 from ..runners.engine import RunMonitor
 from .errors import (
     JobFailed,
@@ -119,12 +120,16 @@ class _Job:
         "job_id", "fn", "tenant", "priority", "deadline_s", "deadline_abs",
         "submit_time", "max_retries", "retry_backoff_s", "retry_on",
         "signature", "handle", "attempts", "seq", "warm_fn", "serial_key",
+        "span",
     )
 
     def __init__(self, **kw):
         for k, v in kw.items():
             setattr(self, k, v)
         self.attempts = 0
+        #: the job's trace span: opened at admission, annotated by every
+        #: attempt/retry/outcome, finished exactly once in _finish
+        self.span = _trace.NULL
 
 
 #: ready-queue entries a worker inspects looking for an affinity match
@@ -192,6 +197,12 @@ class JobScheduler:
             "deequ_service_scan_stalls_total",
             "Engine passes cancelled by the scan watchdog for exceeding "
             "their deadline (hang-not-crash faults).",
+        )
+        self.metrics.describe(
+            "deequ_service_analyzer_cost_seconds_total",
+            "Per-analyzer cost attribution: each signature bundle's "
+            "measured compile+dispatch seconds split across its slots, "
+            "labeled by analyzer repr.",
         )
         self.metrics.set_gauge_fn(
             "deequ_service_queue_depth", self.pending,
@@ -270,6 +281,17 @@ class JobScheduler:
                 handle=handle, seq=seq, warm_fn=warm_fn,
                 serial_key=serial_key,
             )
+            # the trace root of the job's whole causal chain: admission,
+            # every attempt/retry, placement, the engine passes it runs
+            # (children via the worker's attached context), and the
+            # terminal outcome. Submitted under a caller's live span (a
+            # traced streaming ingest) it joins that trace instead.
+            job.span = _trace.start_span(
+                f"job:{jid}", kind="job",
+                attrs={"job_id": jid, "tenant": tenant,
+                       "priority": int(priority)},
+            )
+            job.span.add_event("admitted", depth=depth, seq=seq)
             bisect.insort(self._ready, (int(priority), seq, job))
             self.metrics.inc("deequ_service_jobs_submitted_total", tenant=tenant)
             self._cond.notify()
@@ -372,12 +394,20 @@ class JobScheduler:
                     self._cond.notify_all()
 
     def _execute(self, job: _Job, worker_id: int) -> bool:
-        """Run one job attempt; returns True iff the job was RE-ENQUEUED
-        for retry (the worker then keeps its serial key owned — releasing
-        it would let a later sibling overtake the retry)."""
+        """Run one job attempt under the job's trace context; returns True
+        iff the job was RE-ENQUEUED for retry (the worker then keeps its
+        serial key owned — releasing it would let a later sibling overtake
+        the retry)."""
+        with _trace.attach(job.span):
+            return self._execute_attempt(job, worker_id)
+
+    def _execute_attempt(self, job: _Job, worker_id: int) -> bool:
         now = time.monotonic()
         if job.deadline_abs is not None and now > job.deadline_abs:
             # don't waste a run on a job that already missed its budget
+            job.span.add_event(
+                "queued_past_deadline", waited_s=now - job.submit_time
+            )
             self._finish(
                 job, None,
                 JobTimeout(job.job_id, job.deadline_s, now - job.submit_time),
@@ -385,10 +415,16 @@ class JobScheduler:
             )
             return False
         job.attempts += 1
+        job.span.add_event(
+            "picked_up", worker=worker_id, attempt=job.attempts
+        )
         ctx = JobContext(
             job_id=job.job_id, tenant=job.tenant, attempt=job.attempts,
             worker_id=worker_id,
             placement=self.router.decide(job.signature, job.warm_fn),
+        )
+        job.span.add_event(
+            "placement", decision=ctx.placement or "auto", attempt=job.attempts
         )
         try:
             from ..reliability.faults import fault_point
@@ -422,6 +458,9 @@ class JobScheduler:
             # stays reachable on the handle (late_value) while the caller
             # gets the typed timeout; discarding it would bait callers into
             # re-running committed work
+            job.span.add_event(
+                "completed_late", waited_s=end - job.submit_time
+            )
             job.handle.late_value = value
             self._finish(
                 job, None,
@@ -440,6 +479,11 @@ class JobScheduler:
         for phase, seconds in ctx.monitor.phase_seconds.items():
             job.handle.phase_seconds[phase] = (
                 job.handle.phase_seconds.get(phase, 0.0) + seconds
+            )
+        for analyzer, seconds in dict(ctx.monitor.cost_by_analyzer).items():
+            self.metrics.inc(
+                "deequ_service_analyzer_cost_seconds_total", seconds,
+                analyzer=analyzer, tenant=job.tenant,
             )
         monitor = ctx.monitor
         if monitor.stalls:
@@ -490,6 +534,10 @@ class JobScheduler:
         not_before = time.monotonic() + delay
         if job.deadline_abs is not None and not_before > job.deadline_abs:
             return False  # the backoff alone would blow the deadline
+        job.span.add_event(
+            "retry", attempt=job.attempts, delay_s=delay,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
         self.metrics.inc("deequ_service_job_retries_total", tenant=job.tenant)
         with self._cond:
             heapq.heappush(self._delayed, (not_before, next(self._seq), job))
@@ -503,6 +551,14 @@ class JobScheduler:
             "deequ_service_jobs_completed_total",
             tenant=job.tenant, outcome=outcome,
         )
+        job.span.add_event(
+            "outcome", outcome=outcome, attempts=job.attempts,
+            **({"error": f"{type(error).__name__}: {str(error)[:200]}"}
+               if error is not None else {}),
+        )
+        # finishing the job span closes the trace's unit of work — this is
+        # also what releases any pending flight-recorder dump for it
+        job.span.finish("ok" if error is None else "error")
         job.handle.attempts = job.attempts
         job.handle._finish(value, error)
 

@@ -301,6 +301,8 @@ class StreamingSession:
         batch) with persisted states untouched; returns the (possibly
         repaired) dataset to fold otherwise."""
         from ..exceptions import SchemaDriftError
+        from ..observability import record_failure
+        from ..observability import trace as _trace
 
         metrics = self.service.metrics
         try:
@@ -309,7 +311,11 @@ class StreamingSession:
                 policy=self.drift_policy,
                 session=f"{self.tenant}/{self.dataset}",
             )
-        except SchemaDriftError:
+        except SchemaDriftError as exc:
+            # a rejected batch is a typed failure an operator will want the
+            # trace for: event + flight-recorder dump, then the existing
+            # counter bump and raise
+            record_failure(exc)
             metrics.inc(
                 "deequ_service_drift_rejections_total",
                 tenant=self.tenant, dataset=self.dataset,
@@ -317,6 +323,10 @@ class StreamingSession:
             raise
         if report.coercions:
             self.drift_coercions += len(report.coercions)
+            _trace.add_event(
+                "drift_coerced", columns=len(report.coercions),
+                session=f"{self.tenant}/{self.dataset}",
+            )
             metrics.inc(
                 "deequ_service_drift_coercions_total",
                 float(len(report.coercions)),
@@ -324,6 +334,10 @@ class StreamingSession:
             )
         if report.repaired:
             self.drift_repaired_batches += 1
+            _trace.add_event(
+                "drift_repaired", repaired=list(report.repaired)[:8],
+                session=f"{self.tenant}/{self.dataset}",
+            )
             metrics.inc(
                 "deequ_service_drift_repairs_total",
                 tenant=self.tenant, dataset=self.dataset,
@@ -335,6 +349,10 @@ class StreamingSession:
             )
         if report.degraded:
             self.drift_degraded_batches += 1
+            _trace.add_event(
+                "drift_degraded", columns=list(report.degraded)[:8],
+                session=f"{self.tenant}/{self.dataset}",
+            )
             metrics.inc(
                 "deequ_service_drift_degraded_total",
                 tenant=self.tenant, dataset=self.dataset,

@@ -31,10 +31,28 @@ class VerificationResult:
         status: CheckStatus,
         check_results: Dict[Check, CheckResult],
         metrics: Dict[Analyzer, Metric],
+        cost_by_analyzer: Optional[Dict[str, float]] = None,
     ):
         self.status = status
         self.check_results = check_results
         self.metrics = metrics
+        #: per-analyzer cost attribution (seconds, keyed by repr(analyzer))
+        #: harvested from the run's RunMonitor: each signature bundle's
+        #: measured compile+dispatch time split across its slots. Empty for
+        #: state-only runs (`run_on_aggregated_states`) and when the caller
+        #: evaluated checks against a pre-built context.
+        self.cost_by_analyzer: Dict[str, float] = dict(cost_by_analyzer or {})
+
+    def cost_by_analyzer_as_json(self) -> str:
+        """The attribution table as JSON (sorted most-expensive first):
+        ``[{"analyzer": ..., "seconds": ...}, ...]`` — round-trips through
+        ``json.loads`` back to the table's contents."""
+        rows = sorted(
+            self.cost_by_analyzer.items(), key=lambda kv: -kv[1]
+        )
+        return json.dumps(
+            [{"analyzer": k, "seconds": v} for k, v in rows]
+        )
 
     def success_metrics_as_data_frame(self, for_analyzers: Sequence[Analyzer] = ()):
         return AnalyzerContext(self.metrics).success_metrics_as_dataframe(for_analyzers)
@@ -102,37 +120,49 @@ class VerificationSuite:
         placement: Optional[str] = None,
         checkpointer: Optional[Any] = None,
     ) -> VerificationResult:
+        from .observability import trace as _trace
         from .runners.analysis_runner import collect_required_analyzers
+        from .runners.engine import RunMonitor
 
         checks = list(checks)  # evaluate() walks them again after the run
         analyzers = collect_required_analyzers(checks, required_analyzers)
+        # a monitor always exists so per-analyzer cost attribution reaches
+        # the result even when the caller did not ask for one
+        monitor = monitor if monitor is not None else RunMonitor()
 
-        analysis_results = AnalysisRunner.do_analysis_run(
-            data,
-            analyzers,
-            aggregate_with=aggregate_with,
-            save_states_with=save_states_with,
-            metrics_repository=metrics_repository,
-            reuse_existing_results_for_key=reuse_existing_results_for_key,
-            fail_if_results_missing=fail_if_results_missing,
-            # save AFTER evaluation (below), so anomaly checks never see the
-            # current point in their own history (reference
-            # `VerificationSuite.scala:121-139`)
-            save_or_append_results_with_key=None,
-            batch_size=batch_size,
-            monitor=monitor,
-            sharding=sharding,
-            placement=placement,
-            checkpointer=checkpointer,
-        )
-        result = VerificationSuite.evaluate(checks, analysis_results)
-        if metrics_repository is not None and save_or_append_results_with_key is not None:
-            from .runners.analysis_runner import _save_or_append
-
-            _save_or_append(
-                metrics_repository, save_or_append_results_with_key, analysis_results
+        with _trace.span(
+            "verification_run", kind="verification",
+            checks=len(checks), analyzers=len(analyzers),
+        ):
+            analysis_results = AnalysisRunner.do_analysis_run(
+                data,
+                analyzers,
+                aggregate_with=aggregate_with,
+                save_states_with=save_states_with,
+                metrics_repository=metrics_repository,
+                reuse_existing_results_for_key=reuse_existing_results_for_key,
+                fail_if_results_missing=fail_if_results_missing,
+                # save AFTER evaluation (below), so anomaly checks never see
+                # the current point in their own history (reference
+                # `VerificationSuite.scala:121-139`)
+                save_or_append_results_with_key=None,
+                batch_size=batch_size,
+                monitor=monitor,
+                sharding=sharding,
+                placement=placement,
+                checkpointer=checkpointer,
             )
-        return result
+            with _trace.span("constraint_evaluation", kind="phase"):
+                result = VerificationSuite.evaluate(checks, analysis_results)
+            result.cost_by_analyzer = dict(monitor.cost_by_analyzer)
+            if metrics_repository is not None and save_or_append_results_with_key is not None:
+                from .runners.analysis_runner import _save_or_append
+
+                _save_or_append(
+                    metrics_repository, save_or_append_results_with_key,
+                    analysis_results,
+                )
+            return result
 
     @staticmethod
     def run_on_aggregated_states(

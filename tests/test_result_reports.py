@@ -1,0 +1,203 @@
+"""Result-serialization report scenarios, ported from the reference's
+`VerificationResultTest.scala` / `AnalyzerContextTest.scala`: the
+successMetricsAsJson / checkResultsAsJson record shapes, analyzer
+filtering, status precedence in reports — plus the new
+``cost_by_analyzer`` table's JSON round trip (ISSUE 5 satellite).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.data import Dataset
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from deequ_tpu.runners.context import AnalyzerContext
+from deequ_tpu.verification import VerificationResult, VerificationSuite
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    return Dataset.from_dict(
+        {
+            "item": [str(i) for i in range(1, 5)],
+            "att1": ["a", "b", "a", "a"],
+            "numeric": rng.normal(10.0, 1.0, size=4),
+        }
+    )
+
+
+def _suite(data, *checks):
+    builder = VerificationSuite.on_data(data)
+    for check in checks:
+        builder = builder.add_check(check)
+    return builder.run()
+
+
+class TestSuccessMetricsAsJson:
+    """`VerificationResultTest` "getSuccessMetricsAsJson" scenarios."""
+
+    def test_record_shape_and_values(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.ERROR, "group-1")
+            .has_size(lambda n: n == 4)
+            .is_complete("att1"),
+        )
+        records = json.loads(result.success_metrics_as_json())
+        assert all(
+            set(r) == {"entity", "instance", "name", "value"} for r in records
+        )
+        by_name = {(r["name"], r["instance"]): r for r in records}
+        size = by_name[("Size", "*")]
+        assert size["entity"] == "Dataset" and size["value"] == 4.0
+        comp = by_name[("Completeness", "att1")]
+        assert comp["entity"] == "Column" and comp["value"] == 1.0
+
+    def test_filtering_by_analyzer(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.ERROR, "g")
+            .has_size(lambda n: n == 4)
+            .is_complete("att1"),
+        )
+        only = json.loads(
+            result.success_metrics_as_json(for_analyzers=[Size()])
+        )
+        assert [r["name"] for r in only] == ["Size"]
+
+    def test_failure_metrics_excluded(self, data):
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Size(), Completeness("no_such_column")]
+        )
+        records = json.loads(AnalyzerContext(ctx.metric_map).success_metrics_as_json())
+        assert [r["name"] for r in records] == ["Size"]
+
+    def test_context_addition_merges_metric_maps(self, data):
+        """`AnalyzerContextTest`: two contexts combine; the right side
+        wins on shared analyzers."""
+        ctx1 = AnalysisRunner.do_analysis_run(data, [Size()])
+        ctx2 = AnalysisRunner.do_analysis_run(data, [Completeness("att1")])
+        merged = ctx1 + ctx2
+        assert merged.metric(Size()) is not None
+        assert merged.metric(Completeness("att1")) is not None
+        assert merged.metric(Mean("numeric")) is None
+
+
+class TestCheckResultsAsJson:
+    """`VerificationResultTest` "getCheckResultsAsJson" scenarios."""
+
+    COLUMNS = {
+        "check", "check_level", "check_status", "constraint",
+        "constraint_status", "constraint_message",
+    }
+
+    def test_success_report_shape(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.ERROR, "group-1").has_size(lambda n: n == 4),
+        )
+        rows = json.loads(result.check_results_as_json())
+        assert len(rows) == 1
+        assert set(rows[0]) == self.COLUMNS
+        assert rows[0]["check"] == "group-1"
+        assert rows[0]["check_level"] == "Error"
+        assert rows[0]["check_status"] == "Success"
+        assert rows[0]["constraint_status"] == "Success"
+        assert rows[0]["constraint_message"] == ""
+
+    def test_failing_constraint_carries_message(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.ERROR, "group-2-E")
+            .has_completeness("att1", lambda v: v > 2.0),  # unsatisfiable
+        )
+        rows = json.loads(result.check_results_as_json())
+        assert rows[0]["check_status"] == "Error"
+        assert rows[0]["constraint_status"] == "Failure"
+        assert rows[0]["constraint_message"] != ""
+
+    def test_status_precedence_in_reports(self, data):
+        """Reference precedence: a failing WARNING check yields Warning,
+        any failing ERROR check dominates to Error, all-passing is
+        Success — both on the overall status and per-row in the report."""
+        passing = Check(CheckLevel.ERROR, "ok").has_size(lambda n: n == 4)
+        warning = Check(CheckLevel.WARNING, "warn").has_size(lambda n: n == 0)
+        failing = Check(CheckLevel.ERROR, "bad").has_size(lambda n: n == 0)
+
+        only_pass = _suite(data, passing)
+        assert only_pass.status == CheckStatus.SUCCESS
+
+        warn = _suite(data, passing, warning)
+        assert warn.status == CheckStatus.WARNING
+        rows = {r["check"]: r for r in json.loads(warn.check_results_as_json())}
+        assert rows["ok"]["check_status"] == "Success"
+        assert rows["warn"]["check_status"] == "Warning"
+        assert rows["warn"]["check_level"] == "Warning"
+
+        err = _suite(data, passing, warning, failing)
+        assert err.status == CheckStatus.ERROR
+        rows = {r["check"]: r for r in json.loads(err.check_results_as_json())}
+        assert rows["bad"]["check_status"] == "Error"
+        assert rows["warn"]["check_status"] == "Warning"
+        assert rows["ok"]["check_status"] == "Success"
+
+    def test_dataframe_and_json_agree(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.WARNING, "w").is_complete("att1"),
+        )
+        df = result.check_results_as_data_frame()
+        rows = json.loads(result.check_results_as_json())
+        assert df.to_dict(orient="records") == rows
+
+
+class TestCostByAnalyzerRoundTrip:
+    """ISSUE 5: the new cost table rides VerificationResult and
+    round-trips through JSON."""
+
+    def test_populated_and_round_trips(self, data):
+        result = _suite(
+            data,
+            Check(CheckLevel.ERROR, "costed")
+            .is_complete("att1")
+            .has_mean("numeric", lambda m: 5 < m < 15)
+            .has_min("numeric", lambda v: v < 100)
+            .has_max("numeric", lambda v: v > -100),
+        )
+        assert result.cost_by_analyzer
+        for key in (
+            repr(Completeness("att1")), repr(Mean("numeric")),
+            repr(Minimum("numeric")), repr(Maximum("numeric")),
+        ):
+            assert key in result.cost_by_analyzer
+            assert result.cost_by_analyzer[key] >= 0.0
+        rows = json.loads(result.cost_by_analyzer_as_json())
+        assert all(set(r) == {"analyzer", "seconds"} for r in rows)
+        # sorted most-expensive first
+        seconds = [r["seconds"] for r in rows]
+        assert seconds == sorted(seconds, reverse=True)
+        # lossless round trip
+        assert {r["analyzer"]: r["seconds"] for r in rows} == (
+            result.cost_by_analyzer
+        )
+
+    def test_state_only_run_has_empty_table(self, data):
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        sp = InMemoryStateProvider()
+        check = Check(CheckLevel.ERROR, "c").has_mean(
+            "numeric", lambda m: 5 < m < 15
+        )
+        VerificationSuite.on_data(data).add_check(check).save_states_with(
+            sp
+        ).run()
+        result = VerificationSuite.run_on_aggregated_states(
+            data.schema, [check], [sp]
+        )
+        assert isinstance(result, VerificationResult)
+        assert result.cost_by_analyzer == {}
+        assert json.loads(result.cost_by_analyzer_as_json()) == []

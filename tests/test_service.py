@@ -1060,6 +1060,33 @@ class TestExportPlane:
         assert "# TYPE deequ_test_gauge gauge" in text
         assert "deequ_test_gauge 7" in text
 
+    def test_every_series_has_help_and_type_lines(self):
+        """Prometheus exposition completeness: scrapers and `promtool
+        check metrics` expect a # HELP and # TYPE line for EVERY series,
+        described or not — pin the format."""
+        m = ServiceMetrics()
+        m.describe("deequ_documented_total", "Documented.")
+        m.inc("deequ_documented_total", tenant="a")
+        m.inc("deequ_undocumented_total")  # never describe()d
+        m.set_gauge_fn("deequ_undocumented_gauge", lambda: 1.0)
+        lines = m.prometheus_text().splitlines()
+        series_names = set()
+        for line in lines:
+            if line.startswith("#"):
+                continue
+            series_names.add(line.split("{")[0].split(" ")[0])
+        for name in series_names:
+            assert f"# TYPE {name} " in "\n".join(lines), name
+            assert any(
+                ln.startswith(f"# HELP {name} ") for ln in lines
+            ), f"missing HELP for {name}"
+        # HELP/TYPE precede the first sample of their series
+        help_i = next(
+            i for i, ln in enumerate(lines)
+            if ln.startswith("# HELP deequ_undocumented_total")
+        )
+        assert help_i < lines.index("deequ_undocumented_total 1")
+
     def test_label_values_are_escaped(self):
         m = ServiceMetrics()
         m.inc("deequ_escape_total", tenant='team"a\\b\nc')
@@ -1077,18 +1104,54 @@ class TestExportPlane:
         snap = json.loads(m.json_text())  # JSON stays strictly parseable
         assert snap["gauges"]["deequ_inf_gauge"] is None
 
-    def test_dead_gauge_exports_nan_not_crash(self):
+    def test_poisoned_gauge_skipped_counted_and_rest_served(self):
+        """Export hardening: a gauge callable that RAISES must not kill the
+        exposition — its series is skipped, the failure is counted under
+        deequ_service_export_errors_total, and every other series keeps
+        serving (both Prometheus and JSON)."""
         m = ServiceMetrics()
+        m.inc("deequ_alive_total", 2, tenant="a")
+        m.set_gauge_fn("deequ_live_gauge", lambda: 7)
 
         def dead():
             raise RuntimeError("gone")
 
         m.set_gauge_fn("deequ_dead_gauge", dead)
-        assert "deequ_dead_gauge NaN" in m.prometheus_text()
-        # ... and the JSON side stays strictly parseable (bare NaN is not
-        # valid JSON): the dead gauge reads as null
+        text = m.prometheus_text()
+        # the gauge SERIES is skipped (no sample, no TYPE header) — the
+        # name only survives as the error counter's label
+        assert not any(
+            line.startswith("deequ_dead_gauge")
+            or line.startswith("# TYPE deequ_dead_gauge")
+            for line in text.splitlines()
+        )
+        assert "deequ_live_gauge 7" in text
+        assert 'deequ_alive_total{tenant="a"} 2' in text
+        assert (
+            'deequ_service_export_errors_total{gauge="deequ_dead_gauge"} 1'
+            in text
+        )
         snap = json.loads(m.json_text())
-        assert snap["gauges"]["deequ_dead_gauge"] is None
+        assert "deequ_dead_gauge" not in snap["gauges"]
+        assert snap["gauges"]["deequ_live_gauge"] == 7
+        # two expositions -> two counted failures (monotonic counter)
+        assert (
+            snap["counters"]["deequ_service_export_errors_total"][
+                "gauge=deequ_dead_gauge"
+            ]
+            == 2
+        )
+
+    def test_returned_nan_gauge_still_renders_nan(self):
+        """A gauge that RETURNS NaN (as opposed to raising) is a value,
+        not an export error: Prometheus renders the NaN literal, JSON maps
+        it to null to stay strictly parseable."""
+        m = ServiceMetrics()
+        m.set_gauge_fn("deequ_nan_gauge", lambda: float("nan"))
+        assert "deequ_nan_gauge NaN" in m.prometheus_text()
+        snap = json.loads(m.json_text())
+        assert snap["gauges"]["deequ_nan_gauge"] is None
+        assert m.counter_value("deequ_service_export_errors_total") == 0
 
     def test_json_snapshot_structure(self):
         m = ServiceMetrics()

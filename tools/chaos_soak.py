@@ -178,6 +178,7 @@ def run_soak(
             )
     finally:
         clear()
+    summary.update(_write_trace_artifact(state_root))
     summary["seconds"] = round(time.perf_counter() - t0, 2)
     summary["ok"] = (
         summary["unterminated"] == 0
@@ -188,6 +189,46 @@ def run_soak(
         and summary["repo_drill"]["ok"]
     )
     return summary
+
+
+def _write_trace_artifact(tmpdir: str) -> Dict:
+    """Leave a summarized trace artifact behind after every soak: the
+    flight-recorder ring exports as a Chrome trace, `tools.trace_summarize`
+    renders the critical path / self-time / degradation summary beside it,
+    and both paths land in the soak's JSON so an operator can open the
+    incident directly from the drill output."""
+    import os
+
+    from deequ_tpu.observability import export as obs_export
+    from deequ_tpu.observability import trace as obs_trace
+
+    if not obs_trace.enabled():
+        return {"trace_artifact": None}
+    try:
+        artifact = obs_export.write_chrome_trace(
+            os.path.join(tmpdir, "chaos-trace.json")
+        )
+        from tools.trace_summarize import summarize
+
+        text = summarize(artifact)
+        summary_path = artifact + ".summary.txt"
+        with open(summary_path, "w") as fh:
+            fh.write(text + "\n")
+        print(text, file=sys.stderr, flush=True)
+        degradation_lines = sum(
+            1 for line in text.splitlines() if line.startswith("  +")
+        )
+        return {
+            "trace_artifact": artifact,
+            "trace_summary": summary_path,
+            "trace_degradations": degradation_lines,
+        }
+    except Exception:  # noqa: BLE001 - the soak verdict must not depend on
+        # the post-mortem artifact writing cleanly
+        import traceback
+
+        traceback.print_exc()
+        return {"trace_artifact": None}
 
 
 def _repository_drill(data, tmpdir: str) -> Dict:
