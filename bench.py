@@ -93,16 +93,25 @@ def stage_budget_s() -> float:
     return float(os.environ.get(STAGE_BUDGET_ENV, "180"))
 
 
-def run_stage_with_deadline(name: str, fn, *args, **kwargs):
+def subprocess_timeout_s() -> float:
+    """Wall-clock cap for detached stage subprocesses (prewarm, grouping
+    points): generous enough to absorb a cold XLA compile longer than one
+    stage budget, bounded so a hung child can never wedge the bench."""
+    return max(stage_budget_s() * 2, 300)
+
+
+def run_stage_with_deadline(name: str, fn, *args, budget_s=None, **kwargs):
     """Run one stage under a HARD wall-clock deadline: SIGALRM interrupts
     the main thread mid-stage (numpy/pyarrow/XLA dispatch all return to the
     interpreter frequently enough for delivery), the stage is recorded as
     ``skipped_deadline`` and the bench moves on — a slow stage costs its
-    own numbers, never the stages after it. Returns (result | None,
-    status, seconds)."""
+    own numbers, never the stages after it. ``budget_s`` overrides the
+    default stage budget (the xla_prewarm stage exists to absorb a cold
+    compile LONGER than one stage budget, so it runs under an enlarged
+    deadline). Returns (result | None, status, seconds)."""
     import signal
 
-    budget = stage_budget_s()
+    budget = stage_budget_s() if budget_s is None else float(budget_s)
 
     def on_alarm(signum, frame):
         raise StageDeadline(name)
@@ -120,6 +129,14 @@ def run_stage_with_deadline(name: str, fn, *args, **kwargs):
             f"{elapsed:.1f}s — skipped (partial JSON keeps earlier stages)"
         )
         return None, "skipped_deadline", elapsed
+    except Exception as exc:
+        # a failing stage (dead subprocess, missing native lib, env issue)
+        # costs its own numbers, never the stages after it — the same
+        # contract the deadline path keeps. SystemExit (parity mismatch)
+        # and KeyboardInterrupt still abort the bench.
+        elapsed = time.perf_counter() - t0
+        log(f"[{name}] stage FAILED after {elapsed:.1f}s: {exc!r}")
+        return None, "failed", elapsed
     finally:
         signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, prior)
@@ -556,6 +573,53 @@ def run_device_resident_stage(
     }
 
 
+def run_xla_prewarm_stage() -> dict:
+    """Pre-warm the persistent XLA compilation cache from a DETACHED
+    staging process (ROADMAP item 1): a subprocess runs the 1-batch
+    production-shaped device profile, compiling the ~8 signature-bundled
+    programs into the shared on-disk cache (config.py sets
+    jax_compilation_cache_dir), so the measured device_profile stage's
+    compile probe DESERIALIZES instead of compiling — the r05 failure mode
+    (1140s of XLA compile inside the measured stage) cannot recur. The
+    subprocess's own wall time is reported as this stage's cost."""
+    import os
+    import subprocess
+
+    script = (
+        "import bench; "
+        "from deequ_tpu.data import Dataset; "
+        "from deequ_tpu.profiles import ColumnProfilerRunner; "
+        "t = bench.build_lineitem_data(1 << 20); "
+        "ColumnProfilerRunner.on_data(Dataset.from_arrow(t))"
+        ".with_placement('device').with_batch_size(1 << 20).run(); "
+        "print('prewarm done')"
+    )
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True,
+            timeout=subprocess_timeout_s(),
+        )
+    except subprocess.TimeoutExpired:
+        # a blown prewarm costs its own stage, never the measured ones:
+        # the cache is simply (partially) cold for device_profile
+        elapsed = time.perf_counter() - t0
+        log(f"[xla-prewarm] staging subprocess timed out after {elapsed:.1f}s")
+        return {"seconds": elapsed, "ok": False}
+    elapsed = time.perf_counter() - t0
+    ok = proc.returncode == 0
+    log(
+        f"[xla-prewarm] detached staging process "
+        f"{'populated the persistent XLA cache' if ok else 'FAILED (rc=%d)' % proc.returncode} "
+        f"in {elapsed:.1f}s"
+    )
+    if not ok:
+        log(f"[xla-prewarm] stderr tail: {proc.stderr[-500:]}")
+    return {"seconds": elapsed, "ok": ok}
+
+
 def run_device_profile_stage(target_rows: int | None = None) -> dict:
     """DEVICE-PLACEMENT full column profile at config-3 (lineitem) shape:
     the REAL ColumnProfilerRunner over REAL data with `placement="device"`
@@ -902,8 +966,81 @@ def run_incremental_stage(rows_per_partition: int, n_partitions: int = 2) -> dic
 
 
 # ---------------------------------------------------------------------------
+# stage 3a2: device-resident frequency engine (ROADMAP item 3) — the
+# BENCH_r04 [spill] workload shape through the device table path, with the
+# host group-by measured in a sibling process for the before/after ratio
+# ---------------------------------------------------------------------------
+
+
+def run_grouping_stage(rows: int) -> dict:
+    """25M rows / ~3.6M distinct (rows//7) grouping battery through the
+    DEVICE frequency engine, versus the same workload through the host
+    accumulator — each in a FRESH subprocess so peak RSS is the engine's
+    own, not this process's high-water mark. Metrics must be BIT-exact
+    across the two engines; the host point runs under the r04 [spill]
+    stage's frequency-entry budget so the 'before' includes the disk-spill
+    cost the device engine eliminates."""
+    import subprocess
+
+    from tools.grouping_sweep import subprocess_point
+
+    distinct = max(rows // 7, 1000)
+    budget = max(distinct // 8, 1000)  # the r04 spill-forcing budget
+
+    def point(engine: str, extra_env: dict) -> dict:
+        try:
+            return subprocess_point(
+                rows, distinct, engine, seed=1,
+                timeout=subprocess_timeout_s(), extra_env=extra_env,
+            )
+        except subprocess.TimeoutExpired:
+            # the stage's SIGALRM normally fires first (its budget is below
+            # this cap); if the child itself times out, record the stage as
+            # deadline-skipped rather than killing the stages after it
+            raise StageDeadline("grouping") from None
+
+    dev = point("device", {})
+    host = point("host", {"DEEQU_TPU_MAX_FREQUENCY_ENTRIES": str(budget)})
+    if dev["metrics"] != host["metrics"]:
+        log(f"PARITY MISMATCH grouping engines: {dev['metrics']} != {host['metrics']}")
+        sys.exit(1)
+    ratio = dev["rows_per_sec"] / host["rows_per_sec"]
+    # the r04 comparison only means something at the r04 workload shape
+    # (25M rows / 3.6M distinct); a smoke-scale run must not write the
+    # ROADMAP acceptance ratio from an incomparable workload
+    r04_rate = 1.66e6 if rows == 25_000_000 else None
+    r04_clause = (
+        f"{dev['rows_per_sec']/r04_rate:.1f}x the r04 host-spill rate; "
+        if r04_rate else ""
+    )
+    log(
+        f"[grouping] {rows:,} rows / {dev['distinct']:.0f} distinct: device "
+        f"table {dev['seconds']:.2f}s ({dev['rows_per_sec']/1e6:.1f}M rows/s, "
+        f"peak RSS {dev['peak_rss_gb']:.2f}GB, overflow fallbacks="
+        f"{dev['freq_overflow_fallbacks']}) vs host spill "
+        f"{host['seconds']:.2f}s ({host['rows_per_sec']/1e6:.2f}M rows/s, "
+        f"peak RSS {host['peak_rss_gb']:.2f}GB) -> {ratio:.1f}x live, "
+        f"{r04_clause}metrics bit-exact"
+    )
+    out = {
+        "rows_per_sec": dev["rows_per_sec"],
+        "peak_rss_gb": dev["peak_rss_gb"],
+        "distinct": dev["distinct"],
+        "host_rows_per_sec": host["rows_per_sec"],
+        "host_peak_rss_gb": host["peak_rss_gb"],
+        "vs_host_spill": round(ratio, 2),
+        "overflow_fallbacks": dev["freq_overflow_fallbacks"],
+    }
+    if r04_rate:
+        out["vs_r04_spill"] = round(dev["rows_per_sec"] / r04_rate, 2)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # stage 3b: high-cardinality frequency spill (the Spark shuffle-spill
-# analog): Uniqueness completes under a deliberately small budget
+# analog): Uniqueness completes under a deliberately small budget —
+# SINCE the device frequency engine landed this is the LAST-RESORT tier,
+# measured here with the engine disabled
 # ---------------------------------------------------------------------------
 
 
@@ -944,7 +1081,10 @@ def run_spill_stage(rows: int) -> dict:
         f"{budget:,}-entry budget: {elapsed:.1f}s ({rate/1e6:.2f}M rows/s), "
         f"peak RSS {rss1:.2f}GB (was {rss0:.2f}GB before)"
     )
-    return {"rows_per_sec": rate, "distinct": got, "budget": budget}
+    return {
+        "rows_per_sec": rate, "distinct": got, "budget": budget,
+        "peak_rss_gb": round(rss1, 3),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1050,6 +1190,22 @@ def main() -> None:
     # profile and the config-3 profile produce the numbers the project is
     # judged on, so they run before the synthetic device stages — a late
     # wall-clock kill costs synthetic numbers, never the headline ones.
+    # The detached prewarm subprocess populates the persistent XLA cache
+    # FIRST, so the measured stage deserializes its programs instead of
+    # compiling them (the r05 rc:124 root cause).
+    prewarm = staged(
+        "xla_prewarm", run_xla_prewarm_stage,
+        # the stage exists to absorb a cold compile LONGER than one stage
+        # budget — under the default 1x SIGALRM a >budget compile would be
+        # killed mid-prewarm, leaving a partial cache for the measured
+        # stage to re-pay (the r05 failure mode). The subprocess enforces
+        # its own timeout; the alarm is the backstop above it.
+        budget_s=subprocess_timeout_s() + 30,
+    )
+    if prewarm is not None:
+        out["xla_prewarm_s"] = round(prewarm["seconds"], 1)
+        checkpoint("xla_prewarm", status="ok" if prewarm["ok"] else "failed")
+
     device_profile = staged("device_profile", run_device_profile_stage)
     if device_profile is not None:
         out["device_profile_rows_per_sec"] = round(device_profile["rows_per_sec"], 1)
@@ -1135,10 +1291,25 @@ def main() -> None:
         out["state_merge_bytes"] = incremental["state_bytes"]
         checkpoint("incremental")
 
+    grouping = staged("grouping", run_grouping_stage, max(scan_rows // 2, 100_000))
+    if grouping is not None:
+        out["grouping_rows_per_sec"] = round(grouping["rows_per_sec"], 1)
+        out["grouping_peak_rss_gb"] = grouping["peak_rss_gb"]
+        out["grouping_vs_host_spill"] = grouping["vs_host_spill"]
+        if "vs_r04_spill" in grouping:
+            out["grouping_vs_r04_spill"] = grouping["vs_r04_spill"]
+        checkpoint("grouping", extra={
+            "peak_rss_gb": grouping["peak_rss_gb"],
+            "host_rows_per_sec": grouping["host_rows_per_sec"],
+            "host_peak_rss_gb": grouping["host_peak_rss_gb"],
+            "distinct": grouping["distinct"],
+        })
+
     spill = staged("spill", run_spill_stage, max(scan_rows // 2, 100_000))
     if spill is not None:
         out["spill_rows_per_sec"] = round(spill["rows_per_sec"], 1)
-        checkpoint("spill")
+        out["spill_peak_rss_gb"] = spill["peak_rss_gb"]
+        checkpoint("spill", extra={"peak_rss_gb": spill["peak_rss_gb"]})
 
     suggest = staged(
         "suggest", run_suggestion_stage, max(profile_rows // 20, 100_000)

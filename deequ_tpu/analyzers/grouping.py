@@ -109,6 +109,15 @@ class _SpillStore:
         self.entries_spilled = 0
         self._finalizer = weakref.finalize(self, shutil.rmtree, self.dir, True)
 
+    def close(self) -> None:
+        """Remove the spill directory NOW (idempotent). The GC finalizer is
+        only the backstop: a long-lived process whose collected states are
+        kept alive by stray references would otherwise leak one
+        ``deequ-tpu-freq-spill-*`` temp dir per spilled table until exit.
+        The runner closes its pass-local grouping states as soon as their
+        metrics are derived."""
+        self._finalizer()
+
     def _partition_of(self, frame: pd.DataFrame) -> np.ndarray:
         """Stable per-row hash partition from the KEY COLUMNS (hashing the
         index directly trips pandas' Categorical factorization on NaN level
@@ -121,10 +130,18 @@ class _SpillStore:
     def _to_frame(self, counts: pd.Series) -> pd.DataFrame:
         return counts.rename(self._COUNT).rename_axis(self._key_cols).reset_index()
 
+    def _check_open(self) -> None:
+        if not self._finalizer.alive:
+            raise RuntimeError(
+                "frequency spill store was closed; its partition files are "
+                "gone — serving from it would silently drop counts"
+            )
+
     def append(self, counts: pd.Series) -> None:
         """Scatter one resident table over the hash partitions."""
         import os
 
+        self._check_open()
         if len(counts) == 0:
             return
         frame = self._to_frame(counts)
@@ -143,6 +160,8 @@ class _SpillStore:
         once across all yields). ``extra`` is a not-yet-spilled resident
         table folded in (hashed with the same function)."""
         import os
+
+        self._check_open()
 
         extra_parts: Dict[int, pd.Series] = {}
         if extra is not None and len(extra):
@@ -277,15 +296,30 @@ class FrequenciesAndNumRows:
             nd = 0
             singles = 0
             total = 0
-            c_ln_c = 0.0
+            # count-of-counts histogram accumulated chunk-by-chunk: holds
+            # one entry per distinct COUNT VALUE (O(sqrt(rows)) worst
+            # case), never the table itself — the spill tier's chunks stay
+            # bounded. Reduced through the same canonical function the
+            # device path's _sum_c_ln_c uses, so Entropy stays
+            # bit-identical across paths and chunkings.
+            hist: dict = {}
             for chunk in self.iter_merged_chunks():
-                c = chunk.to_numpy(dtype=np.float64)
+                c = chunk.to_numpy(dtype=np.int64)
                 nd += len(c)
                 singles += int((c == 1).sum())
                 total += int(c.sum())
-                pos = c[c > 0]
-                c_ln_c += float((pos * np.log(pos)).sum())
-            self._summary = (nd, singles, total, c_ln_c)
+                uc, mult = np.unique(c[c > 0], return_counts=True)
+                for v, m in zip(uc.tolist(), mult.tolist()):
+                    hist[v] = hist.get(v, 0) + int(m)
+            if hist:
+                uc = np.fromiter(sorted(hist), np.int64, count=len(hist))
+                mult = np.array([hist[int(v)] for v in uc], dtype=np.int64)
+            else:
+                uc = np.empty(0, np.int64)
+                mult = np.empty(0, np.int64)
+            self._summary = (
+                nd, singles, total, _reduce_count_histogram(uc, mult)
+            )
         return self._summary
 
     def is_empty(self) -> bool:
@@ -356,7 +390,23 @@ class FrequenciesAndNumRows:
         if self._buffered >= max(len(self._merged), MIN_FLUSH_ENTRIES):
             self._flush()
 
+    def close(self) -> None:
+        """Release the hash-partition spill directory NOW (idempotent,
+        no-op when nothing spilled). After closing, a spilled state refuses
+        to serve (its partition files are gone); the runner closes its
+        pass-local states once their metrics are derived, and the GC
+        finalizer remains the backstop for everything else."""
+        if self._spill is not None:
+            self._spill.close()
+
     def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+        if not isinstance(other, FrequenciesAndNumRows):
+            raise TypeError(
+                f"cannot merge a value-keyed frequency table with "
+                f"{type(other).__name__}: hashed device-frequency states "
+                "and host group-by states never mix (the runner gates the "
+                "device table engine off runs that persist or aggregate)"
+            )
         merged = _add_series(self.frequencies, other.frequencies)
         return FrequenciesAndNumRows(merged, self.num_rows + other.num_rows, self.group_columns)
 
@@ -462,6 +512,29 @@ def _arrow_value_counts(arr) -> Optional[pd.Series]:
     return pd.Series(counts.astype(np.int64), index=keys)
 
 
+def _sum_c_ln_c(counts: np.ndarray) -> float:
+    """sum(count * ln(count)) over a count multiset in CANONICAL order: the
+    count-of-counts histogram reduced in ascending count value. The device
+    frequency engine surfaces counts keyed by 64-bit hashes, the host
+    group-by keys them by value — same multiset, different array order, and
+    float addition is not associative. The histogram form is a pure
+    function of the multiset, so the two paths (and any chunking of the
+    host spill — see ``FrequenciesAndNumRows.stream_summary``, which
+    accumulates the same histogram chunk-by-chunk in bounded memory)
+    produce the bit-identical Entropy."""
+    counts = np.asarray(counts, dtype=np.int64)
+    uc, mult = np.unique(counts[counts > 0], return_counts=True)
+    return _reduce_count_histogram(uc, mult)
+
+
+def _reduce_count_histogram(uc: np.ndarray, mult: np.ndarray) -> float:
+    """The shared canonical reduction: ``uc`` ascending unique count
+    values, ``mult`` their multiplicities. Every c*ln(c) consumer must
+    reach this exact function for bit-identical results."""
+    pos = uc.astype(np.float64)
+    return float((mult.astype(np.float64) * (pos * np.log(pos))).sum())
+
+
 def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
     """Outer-join add of two count series; tolerates empty operands whose
     index types don't match the other side's (Range vs MultiIndex)."""
@@ -474,8 +547,103 @@ def _add_series(a: pd.Series, b: pd.Series) -> pd.Series:
 
 #: dictionary sizes up to this ride the fused device scan (one-hot /
 #: sort-based counting, see DeviceFrequencyScan.update); larger
-#: dictionaries fall back to the amortized host group-by
+#: dictionaries fall back to the device frequency TABLE engine (hashed
+#: keys) or the amortized host group-by. Env-overridable via
+#: DEEQU_TPU_DEVICE_FREQ_MAX_CARDINALITY (read through
+#: :func:`device_freq_max_cardinality`).
 DEVICE_FREQ_MAX_CARDINALITY = 1 << 16
+
+DEVICE_FREQ_MAX_CARDINALITY_ENV = "DEEQU_TPU_DEVICE_FREQ_MAX_CARDINALITY"
+
+#: env var switching the device frequency TABLE engine ("0" disables; the
+#: dense dictionary path above stays on — it predates the table engine)
+DEVICE_FREQ_ENV = "DEEQU_TPU_DEVICE_FREQ"
+
+#: env var sizing the frequency table: distinct-group capacity per grouping
+#: set (rounded up to a power of two; capped per run at the row count,
+#: since distinct <= rows). Bigger tables push the overflow knee out at the
+#: cost of HBM and per-compaction sort width.
+FREQ_TABLE_SLOTS_ENV = "DEEQU_TPU_FREQ_TABLE_SLOTS"
+DEFAULT_FREQ_TABLE_SLOTS = 1 << 22
+
+#: env var capping the raw key buffer (entries, 8B each; rounded up to a
+#: power of two). Runs whose padded row count fits under the cap ride the
+#: RESIDENT trace: every per-row key stays buffered on device and the
+#: drain aggregates once — no in-pass compaction sorts at all. Larger runs
+#: fall back to the conditional-compaction trace (the sorted fixed-shape
+#: table bounds drain work; the sort amortizes over buffer/batch batches),
+#: whose buffer floor is one padded batch — the cap cannot shrink it below
+#: that.
+FREQ_BUFFER_ENTRIES_ENV = "DEEQU_TPU_FREQ_BUFFER_ENTRIES"
+DEFAULT_FREQ_BUFFER_ENTRIES = 1 << 25  # 256MB of u64 keys
+
+#: env var gating the pre-routing cardinality probe ("0" disables it, so
+#: every eligible grouping set takes the device table no matter how small
+#: it looks — tools/grouping_sweep uses this to measure the raw table
+#: curve). With the probe on, sets that confidently look low-cardinality
+#: stay on the host group-by, whose value_counts fast path wins below the
+#: sweep knee.
+FREQ_HOST_ROUTE_ENV = "DEEQU_TPU_FREQ_HOST_ROUTE"
+
+#: warn-once latches for unparseable env overrides (the watchdog/trace
+#: convention: never crash a run over a typo'd knob, never spam the log)
+_ENV_WARNED: set = set()
+
+
+def _env_int(env: str, default: int) -> int:
+    """Validated positive-int env knob: unparseable or non-positive values
+    warn ONCE and fall back to the default instead of crashing every pass
+    (the DEEQU_TPU_SCAN_DEADLINE_S / DEEQU_TPU_TRACE precedent)."""
+    import logging
+    import os
+
+    raw = os.environ.get(env)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+        if value <= 0:
+            raise ValueError(raw)
+    except ValueError:
+        if env not in _ENV_WARNED:
+            _ENV_WARNED.add(env)
+            logging.getLogger(__name__).warning(
+                "ignoring invalid %s=%r (expected a positive integer); "
+                "using the default %d", env, raw, default,
+            )
+        return default
+    return value
+
+
+def device_freq_max_cardinality() -> int:
+    """The dense dictionary-path cardinality ceiling, env-overridable."""
+    return _env_int(DEVICE_FREQ_MAX_CARDINALITY_ENV, DEVICE_FREQ_MAX_CARDINALITY)
+
+
+def freq_table_slots() -> int:
+    """Configured distinct-group capacity of the device frequency table."""
+    return _env_int(FREQ_TABLE_SLOTS_ENV, DEFAULT_FREQ_TABLE_SLOTS)
+
+
+def freq_buffer_entries() -> int:
+    """Configured raw-key buffer cap (the resident-mode ceiling)."""
+    return _env_int(FREQ_BUFFER_ENTRIES_ENV, DEFAULT_FREQ_BUFFER_ENTRIES)
+
+
+def device_freq_enabled() -> bool:
+    import logging
+    import os
+
+    raw = os.environ.get(DEVICE_FREQ_ENV)
+    if raw is None or raw in ("0", "1"):
+        return raw != "0"
+    if DEVICE_FREQ_ENV not in _ENV_WARNED:
+        _ENV_WARNED.add(DEVICE_FREQ_ENV)
+        logging.getLogger(__name__).warning(
+            "ignoring invalid %s=%r (expected 0 or 1); device frequency "
+            "engine stays enabled", DEVICE_FREQ_ENV, raw,
+        )
+    return True
 
 
 @dataclass(frozen=True)
@@ -587,6 +755,420 @@ class DeviceFrequencyScan(ScanShareableAnalyzer):
             "DeviceFrequencyScan states convert via to_frequencies; the "
             "grouping analyzers sharing the set own the metrics"
         )
+
+
+def _u64_value_counts(keys: np.ndarray, weights):
+    """Exact (unique key -> summed weight) over u64 hash keys: the
+    cache-partitioned native kernel when built (hundreds of ms for 25M
+    keys), a numpy argsort + reduceat otherwise. ``weights=None`` counts
+    each key once (the resident-buffer fast path — no materialized ones
+    array); explicit weights must be positive (the native kernel treats
+    zero as the empty-slot marker)."""
+    if len(keys) == 0:
+        return keys.astype(np.uint64), np.zeros(0, dtype=np.int64)
+    from ..native import native_u64_value_counts
+
+    if native_u64_value_counts is not None:
+        out = native_u64_value_counts(keys, weights)
+        if out is not None:
+            return out
+    order = np.argsort(keys, kind="stable")
+    k = keys[order]
+    w = (
+        np.ones(len(k), dtype=np.int64)
+        if weights is None
+        else weights[order].astype(np.int64)
+    )
+    starts = np.flatnonzero(np.concatenate([[True], k[1:] != k[:-1]]))
+    return k[starts], np.add.reduceat(w, starts)
+
+
+class HashedFrequencies:
+    """Exact count multiset keyed by 64-bit GROUP-KEY HASHES — the drained
+    host view of a :class:`~..analyzers.states.FrequencyTableState`.
+
+    The scalar frequency reductions (Uniqueness, Distinctness,
+    UniqueValueRatio, CountDistinct, Entropy) are pure functions of the
+    count multiset plus ``num_rows``; hashing the keys loses nothing for
+    them. Key-READING consumers (Histogram bins, MutualInformation
+    marginals) never receive one — runner eligibility keeps those on the
+    dictionary or host paths. Reads through the same
+    ``stream_summary``/``num_distinct``/``is_empty`` protocol as
+    :class:`FrequenciesAndNumRows`, so the analyzers' metric code is
+    state-type agnostic."""
+
+    __slots__ = ("keys", "counts", "num_rows", "group_columns", "_summary")
+
+    def __init__(
+        self,
+        keys: np.ndarray,
+        counts: np.ndarray,
+        num_rows: int,
+        group_columns: Sequence[str],
+    ):
+        self.keys = np.asarray(keys, dtype=np.uint64)
+        self.counts = np.asarray(counts, dtype=np.int64)
+        self.num_rows = int(num_rows)
+        self.group_columns = list(group_columns)
+        self._summary: Optional[Tuple[int, int, int, float]] = None
+
+    def num_distinct(self) -> int:
+        return len(self.counts)
+
+    def is_empty(self) -> bool:
+        return len(self.counts) == 0
+
+    def stream_summary(self) -> Tuple[int, int, int, float]:
+        """(num_distinct, singleton_count, sum(count), sum(count*ln(count)))
+        — the same cached quadruple FrequenciesAndNumRows serves."""
+        if self._summary is None:
+            self._summary = (
+                len(self.counts),
+                int((self.counts == 1).sum()),
+                int(self.counts.sum()),
+                _sum_c_ln_c(self.counts),
+            )
+        return self._summary
+
+    def close(self) -> None:  # protocol parity with FrequenciesAndNumRows
+        pass
+
+    def sum(self, other: "HashedFrequencies") -> "HashedFrequencies":
+        if not isinstance(other, HashedFrequencies):
+            raise TypeError(
+                f"cannot merge a hashed frequency state with "
+                f"{type(other).__name__}: hashed device-frequency states "
+                "and value-keyed host states never mix"
+            )
+        keys, counts = _u64_value_counts(
+            np.concatenate([self.keys, other.keys]),
+            np.concatenate([self.counts, other.counts]),
+        )
+        return HashedFrequencies(
+            keys, counts, self.num_rows + other.num_rows, self.group_columns
+        )
+
+
+@dataclass(frozen=True)
+class DeviceFrequencyTableScan(ScanShareableAnalyzer):
+    """ARBITRARY-cardinality grouping frequencies computed ON DEVICE inside
+    the fused pass (ROADMAP item 3: the refactor that kills the host
+    ``value_counts`` + hash-partitioned spill default).
+
+    Per batch, each grouping column contributes one 64-bit column key —
+    integral/boolean columns mix their raw ``num`` feature through the
+    bijective SplitMix64 avalanche ON DEVICE (zero host hashing);
+    string/fractional columns ship per-row xxhash64 keys computed by the
+    host feature frontend (dictionary columns gather cached per-entry
+    hashes). Multi-column sets chain column keys with xxhash64, each key
+    seeding the next (the Spark ``XxHash64`` chaining shape), so a combined
+    key depends on every column and on column order — and multi-column
+    grouping finally leaves the host path. The per-row keys append into the
+    state's pow2 buffer at memcpy speed; a sort-merge compaction
+    (:func:`deequ_tpu.ops.freq_compact`) folds the buffer into the sorted
+    fixed-shape table only when it would overflow, keeping the trace
+    shape-static and signature-bundleable.
+
+    Tiering: groups beyond the table's ``slots`` capacity are dropped with
+    EXACT loss accounting (``lost_rows``); the runner detects a lossy drain
+    and re-runs just those grouping sets through the host accumulator
+    (whose ``_SpillStore`` is thereby the LAST-RESORT tier instead of the
+    default path).
+
+    Runner-internal, like :class:`DeviceFrequencyScan`: the runner
+    instantiates it for eligible sets and drains the state into a
+    :class:`HashedFrequencies` every member analyzer reads."""
+
+    columns: Tuple[str, ...] = ()
+    #: per-column key derivation, positionally parallel to ``columns``:
+    #: "num" (device SplitMix64 over the shared numeric feature) or "hash"
+    #: (host xxhash64 feature). Part of the frozen identity — it changes
+    #: the traced update.
+    column_kinds: Tuple[str, ...] = ()
+    slots: int = 0
+    buffer_entries: int = 0
+    #: RESIDENT mode: the planner proved ``buffer_entries`` covers every
+    #: padded batch of the run, so the update emits NO compaction cond —
+    #: the hot path is a pure donated-carry append (frozen identity: it
+    #: changes the traced program)
+    resident: bool = False
+    name: str = field(default="DeviceFrequencyTableScan", init=False)
+
+    supports_host_partial = False  # raw keys must stream to the device;
+    # on a feed-starved link the runner keeps the set on the host group-by
+
+    @property
+    def instance(self) -> str:
+        return ",".join(self.columns)
+
+    def scan_program_key(self) -> Tuple:
+        # ``resident`` flips ``assume_fits`` inside ``update`` — a traced
+        # control-flow difference invisible to state shapes and feature
+        # kinds. Without this key a non-resident run whose (slots, buffer)
+        # happen to match a cached resident program would execute the
+        # cond-free trace and overflow the buffer silently.
+        return (self.resident,)
+
+    def feature_specs(self):
+        from .base import (
+            hash_feature,
+            mask_feature,
+            numeric_feature,
+            rows_feature,
+        )
+
+        specs = [rows_feature()]
+        for col, kind in zip(self.columns, self.column_kinds):
+            specs.append(mask_feature(col))
+            specs.append(
+                numeric_feature(col) if kind == "num" else hash_feature(col)
+            )
+        return specs
+
+    def init_state(self):
+        from .states import FrequencyTableState
+
+        return FrequencyTableState.init(self.slots, self.buffer_entries)
+
+    def update(self, state, features):
+        import jax.numpy as jnp
+
+        from ..ops.hashing import (
+            FREQ_KEY_SENTINEL,
+            splitmix64_jnp,
+            xxhash64_u64_jnp,
+        )
+        from .base import hash_feature, mask_feature, numeric_feature
+
+        rows = features["rows"]
+        valid = rows
+        for col in self.columns:
+            valid = valid & features[mask_feature(col).key]
+        key = None
+        for col, kind in zip(self.columns, self.column_kinds):
+            if kind == "num":
+                # value conversion (not a bitcast — the TPU x64 emulation
+                # implements no 64-bit bitcasts): int dtypes wrap modulo
+                # 2^64 (bijective per dtype), boolean rides its f64 0/1
+                # feature. Masked slots hold arbitrary bytes and are
+                # sentinel-keyed below.
+                ck = splitmix64_jnp(
+                    features[numeric_feature(col).key].astype(jnp.uint64)
+                )
+            else:
+                ck = features[hash_feature(col).key]
+            key = ck if key is None else xxhash64_u64_jnp(ck, key)
+        sent = jnp.uint64(FREQ_KEY_SENTINEL)
+        # a real key colliding with the sentinel would read as a masked row:
+        # count those rows exactly instead (they form one group per the
+        # bijective single-column mixes; for hashed keys two such groups
+        # colliding is a ~2^-64 event) and restore the group at drain time
+        is_sent = valid & (key == sent)
+        keys = jnp.where(valid & (key != sent), key, sent)
+        return state.append_keys(
+            keys,
+            jnp.sum(is_sent, dtype=jnp.int64),
+            jnp.sum(rows, dtype=jnp.int64),
+            assume_fits=self.resident,
+        )
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def drain(self, state) -> Optional[HashedFrequencies]:
+        """Fetched (host numpy) state -> exact HashedFrequencies, or None
+        when compactions dropped groups (``lost_rows > 0``) — the runner
+        then re-runs this set through the host accumulator tier."""
+        from ..ops.hashing import FREQ_KEY_SENTINEL
+
+        if int(state.lost_rows) > 0:
+            return None
+        sent_key = np.uint64(FREQ_KEY_SENTINEL)
+        buf = np.asarray(state.buf)[: int(state.buf_fill)]  # contiguous view
+        if int(state.n_table) == 0:
+            # resident fast path: the whole run lives in the buffer — feed
+            # the view straight to the aggregation (no concat copy, no
+            # 25M-row sentinel pre-filter; the sentinel aggregates into ONE
+            # output entry dropped below)
+            keys, counts = _u64_value_counts(buf, None)
+        else:
+            tcounts = np.asarray(state.sorted_counts)
+            nz = tcounts > 0
+            tkeys = np.asarray(state.sorted_keys)[nz]
+            tcounts = tcounts[nz]
+            keys, counts = _u64_value_counts(
+                np.concatenate([tkeys, buf]),
+                np.concatenate([tcounts, np.ones(len(buf), dtype=np.int64)]),
+            )
+        # drop the aggregated sentinel group (masked/null rows, structural
+        # batch padding, and valid rows whose key collided with the
+        # sentinel — the last counted exactly in sent_rows and restored as
+        # their own group here)
+        at = np.flatnonzero(keys == sent_key)
+        if len(at):
+            keys = np.delete(keys, at)
+            counts = np.delete(counts, at)
+        sent = int(state.sent_rows)
+        if sent:
+            keys = np.concatenate([keys, [sent_key]])
+            counts = np.concatenate([counts, [np.int64(sent)]])
+        return HashedFrequencies(
+            keys, counts, int(state.num_rows), list(self.columns)
+        )
+
+    def compute_metric_from(self, state):  # pragma: no cover - runner-internal
+        raise NotImplementedError(
+            "DeviceFrequencyTableScan states convert via drain; the "
+            "grouping analyzers sharing the set own the metrics"
+        )
+
+
+def _next_pow2(v: int) -> int:
+    p = 1
+    while p < v:
+        p <<= 1
+    return p
+
+
+#: union-distinct ceiling for confidently routing a grouping set to the
+#: host group-by instead of the device table (~the PERF.md knee / 4: below
+#: ~100k distinct the host value_counts fast path wins ~3x, above it the
+#: device table wins up to ~13x, so the probe only answers "host" on
+#: strong low-cardinality evidence)
+_FREQ_HOST_ROUTE_MAX_DISTINCT = 1 << 15
+_FREQ_PROBE_ROWS = 1 << 16
+#: below this row count the probe never routes host: the absolute cost of
+#: either engine is negligible at small n, so tiny runs keep the device
+#: table (and its test coverage) — the host/device rows-per-second gap
+#: only buys wall-clock at scale
+_FREQ_HOST_ROUTE_MIN_ROWS = 1 << 21
+
+
+def probably_low_cardinality(
+    data, columns: Sequence[str], limit: int = _FREQ_HOST_ROUTE_MAX_DISTINCT
+) -> bool:
+    """Cheap pre-routing probe: True when EVERY column of the grouping set
+    confidently looks low-cardinality, so the host group-by's
+    ``value_counts`` fast path will beat the device frequency table (the
+    sweep knee sits ~100k distinct; at 100 distinct the host path is ~3x
+    faster). Mirrors the adaptive dictionary-encode probe in
+    ``data._maybe_dictionary_encode``: head/mid/tail slices, and a
+    clustered/sorted layout — whose later slices keep revealing NEW keys —
+    is rejected via cross-slice novelty, because its low per-slice counts
+    say nothing about total cardinality. Mis-detection is perf-only and
+    asymmetric (a false "device" costs ~3x at tiny cardinalities, a false
+    "host" forfeits up to ~13x at scale), so uncertainty answers False."""
+    import logging
+    import os
+
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    raw = os.environ.get(FREQ_HOST_ROUTE_ENV)
+    if raw is not None and raw not in ("0", "1"):
+        if FREQ_HOST_ROUTE_ENV not in _ENV_WARNED:
+            _ENV_WARNED.add(FREQ_HOST_ROUTE_ENV)
+            logging.getLogger(__name__).warning(
+                "ignoring invalid %s=%r (expected 0 or 1); cardinality "
+                "pre-routing stays enabled", FREQ_HOST_ROUTE_ENV, raw,
+            )
+        raw = None
+    if raw == "0":
+        return False
+    n = int(data.num_rows)
+    if n <= _FREQ_HOST_ROUTE_MIN_ROWS:
+        return False
+    estimate = 1
+    for col in columns:
+        dictionary = data.dictionary_values(col)
+        if dictionary is not None:
+            card = len(dictionary)  # exact
+        else:
+            try:
+                column = data.arrow.column(col)
+                # disjoint head/mid/tail slices (n > MIN_ROWS >> 3 probes)
+                slices = [
+                    column.slice(start, _FREQ_PROBE_ROWS)
+                    for start in (
+                        0,
+                        (n - _FREQ_PROBE_ROWS) // 2,
+                        n - _FREQ_PROBE_ROWS,
+                    )
+                ]
+                per_slice = [pc.count_distinct(s).as_py() for s in slices]
+                union = pc.count_distinct(
+                    pa.chunked_array([c for s in slices for c in s.chunks])
+                ).as_py()
+                if union > 1.5 * max(per_slice):
+                    # later slices kept revealing new keys: clustered
+                    # high-cardinality layout (or genuinely growing key
+                    # space) — not confident, take the device table
+                    return False
+                card = union
+            except Exception:  # noqa: BLE001 - exotic layout: stay on device
+                return False
+        estimate *= max(card, 1)
+        if estimate > limit:
+            return False
+    return True
+
+
+def plan_table_scan(
+    schema, columns: Sequence[str], num_rows: int, batch_rows: int,
+    sharded: bool = False,
+) -> Optional[DeviceFrequencyTableScan]:
+    """Size a DeviceFrequencyTableScan for one grouping set, or None when a
+    column's kind cannot derive a 64-bit key.
+
+    Shapes are pow2-bucketed so the compiled-program space stays small and
+    warm across runs. When every padded batch of the run fits the key
+    buffer (cap :func:`freq_buffer_entries`, default 2^25), the scan runs
+    RESIDENT: per-row keys append at memcpy speed with NO compaction cond
+    in the trace, and the single drain-time aggregation is exact for ANY
+    cardinality up to the buffer — the fast path the bench grouping stage
+    measures. An UNSHARDED resident plan gets a minimal table (the trace
+    never compacts into it and drain ignores it, so full slots would be
+    ~67MB of dead HBM + fetch transfer per set); sharded resident states
+    DO compact into the table at collective merge, so they keep full
+    capacity. Larger runs get the conditional-compaction trace: ``slots``
+    is the configured table capacity capped at the row count (distinct <=
+    rows, so a table with slots >= rows can NEVER overflow) and the
+    buffer covers at least one padded batch so the compaction sort
+    amortizes."""
+    from ..data import ColumnKind
+
+    kinds: List[str] = []
+    for col in columns:
+        kind = schema[col].kind
+        if kind in (ColumnKind.INTEGRAL, ColumnKind.BOOLEAN):
+            kinds.append("num")
+        elif kind in (ColumnKind.FRACTIONAL, ColumnKind.STRING):
+            kinds.append("hash")
+        else:
+            return None
+    slots = _next_pow2(
+        min(freq_table_slots(), max(int(num_rows), 1024))
+    )
+    batch_rows = max(int(batch_rows), 1)
+    # every batch appends its PADDED length (masked padding rows are
+    # sentinel-keyed but still occupy buffer slots), so resident mode must
+    # cover ceil(rows/batch) full batches
+    padded_rows = -(-max(int(num_rows), 1) // batch_rows) * batch_rows
+    # the knob is documented "rounded up to a power of two": compare
+    # against the rounded cap so a non-pow2 setting admits exactly the
+    # runs its allocated (pow2) buffer can hold
+    buffer_cap = _next_pow2(freq_buffer_entries())
+    if padded_rows <= buffer_cap:
+        return DeviceFrequencyTableScan(
+            tuple(columns), tuple(kinds), slots if sharded else 8,
+            _next_pow2(max(padded_rows, batch_rows)), resident=True,
+        )
+    buffer_entries = _next_pow2(
+        max(batch_rows, min(slots, 1 << 20, buffer_cap))
+    )
+    return DeviceFrequencyTableScan(
+        tuple(columns), tuple(kinds), slots, buffer_entries
+    )
 
 
 class GroupingAnalyzer(Analyzer[FrequenciesAndNumRows, DoubleMetric]):

@@ -78,6 +78,33 @@ def slim_fetch_enabled() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Device frequency engine (implemented in deequ_tpu.analyzers.grouping; the
+# env knobs are documented here with the other operator-facing switches and
+# re-exported below). All three follow the warn-and-fallback convention:
+# an unparseable value warns once and keeps the default, never crashes.
+#
+# - DEEQU_TPU_DEVICE_FREQ: "0" disables the device-resident frequency
+#   TABLE engine (hashed fixed-shape count tables for arbitrary-cardinality
+#   grouping sets); grouping then accumulates through the host group-by.
+#   The dense dictionary path is unaffected.
+# - DEEQU_TPU_FREQ_TABLE_SLOTS: distinct-group capacity per grouping set
+#   (default 2^22; rounded up to a power of two, capped per run at the row
+#   count). Sets whose cardinality exceeds it overflow EXACTLY and re-run
+#   on the host last-resort tier.
+# - DEEQU_TPU_DEVICE_FREQ_MAX_CARDINALITY: dictionary-size ceiling of the
+#   dense per-code device counting path (default 2^16).
+# - DEEQU_TPU_FREQ_BUFFER_ENTRIES: raw key-buffer cap (default 2^25 = 256MB
+#   of u64 keys; rounded up to a power of two). Runs whose padded row count
+#   fits ride the RESIDENT trace (memcpy-speed appends, zero in-pass
+#   compactions, exact at any cardinality); larger runs use the
+#   conditional-compaction trace.
+# - DEEQU_TPU_FREQ_HOST_ROUTE: "0" disables the cardinality pre-routing
+#   probe — every eligible grouping set takes the device table even when a
+#   cheap probe says the host group-by's value_counts fast path would win
+#   (confidently-low-cardinality sets at >2M rows).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -113,3 +140,10 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 #   CorruptStateError / SchemaDriftError). Unset = per-process temp dir.
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .observability.trace import TRACE_ENV, TRACE_RING_ENV  # noqa: E402,F401
+from .analyzers.grouping import (  # noqa: E402,F401
+    DEVICE_FREQ_ENV,
+    DEVICE_FREQ_MAX_CARDINALITY_ENV,
+    FREQ_BUFFER_ENTRIES_ENV,
+    FREQ_HOST_ROUTE_ENV,
+    FREQ_TABLE_SLOTS_ENV,
+)

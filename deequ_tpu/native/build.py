@@ -27,7 +27,7 @@ def build(force: bool = False) -> str:
     # never dlopen a half-written library
     tmp = f"{LIBRARY}.{os.getpid()}.tmp"
     cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-pthread",
         "-o", tmp, SOURCE, "-ldl",
     ]
     try:

@@ -28,3 +28,51 @@ def chunked_key_fold(keys, pad_value, init, fold_chunk, chunk: int = 4096):
         lambda a, row: (fold_chunk(a, row), None), init, keys.reshape(-1, c)
     )
     return acc
+
+
+def freq_compact(keys, counts, out_size: int, sentinel):
+    """Sort-merge compaction of (key, count) pairs into at most ``out_size``
+    sorted uniques — the device frequency engine's table maintenance, shared
+    by the in-pass buffer compaction and the semigroup state merge so the
+    two cannot drift.
+
+    Scatter-free by construction (XLA scatters serialize on TPU, see
+    DeviceFrequencyScan.update): one pair-sort brings equal keys adjacent,
+    a cumsum over the sorted counts turns segment sums into two gathers,
+    and the compaction gather indices come from searchsorted over the
+    running unique rank — every step is a sort, scan or gather the TPU
+    vectorizes. Entries with ``key == sentinel`` (masked rows, structural
+    padding) contribute nothing and sort last.
+
+    Returns ``(out_keys, out_counts, n_unique, kept_rows, total_rows)``:
+    ``out_size`` sorted unique keys (sentinel-padded past ``n_unique``)
+    with summed counts. ``n_unique`` is the RAW distinct count of the
+    input, which may exceed ``out_size``: the smallest ``out_size`` uniques
+    are kept, the rest are dropped, and the caller accounts
+    ``max(n_unique - out_size, 0)`` groups / ``total_rows - kept_rows``
+    rows as lost (the overflow tier's exact loss ledger).
+    """
+    import jax.numpy as jnp
+
+    k, c = jax.lax.sort((keys, counts), num_keys=1)
+    n = k.shape[0]
+    # caller contract: sentinel-keyed entries carry count 0 and real keys
+    # carry counts >= 1, so segment sums need no per-entry validity test
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), k[1:] != k[:-1]]
+    ) & (k != sentinel)
+    ranks = jnp.cumsum(is_start.astype(jnp.int64))
+    n_unique = ranks[-1]
+    tot = jnp.cumsum(c)
+    target = jnp.arange(1, out_size + 1, dtype=jnp.int64)
+    pos = jnp.clip(jnp.searchsorted(ranks, target, side="left"), 0, n - 1)
+    pos_next = jnp.searchsorted(ranks, target + 1, side="left")
+    valid = target <= n_unique
+    out_keys = jnp.where(valid, k[pos], sentinel)
+    seg_end = tot[jnp.clip(pos_next - 1, 0, n - 1)]
+    seg_end = jnp.where(pos_next >= n, tot[n - 1], seg_end)
+    seg_begin = jnp.where(pos > 0, tot[pos - 1], 0)
+    out_counts = jnp.where(valid, seg_end - seg_begin, 0)
+    total_rows = tot[n - 1]
+    kept_rows = jnp.sum(out_counts)
+    return out_keys, out_counts, n_unique, kept_rows, total_rows

@@ -30,11 +30,12 @@ def _rotl(x: np.ndarray, r: int) -> np.ndarray:
     return (x << r) | (x >> (np.uint64(64) - r))
 
 
-def xxhash64_u64(values: np.ndarray, seed: int = DEFAULT_SEED) -> np.ndarray:
-    """Vectorized xxHash64 of 8-byte little-endian inputs (one u64 per row)."""
+def xxhash64_u64(values: np.ndarray, seed=DEFAULT_SEED) -> np.ndarray:
+    """Vectorized xxHash64 of 8-byte little-endian inputs (one u64 per row).
+    ``seed`` may be a scalar or a per-row u64 array (broadcast)."""
     values = np.ascontiguousarray(values, dtype=np.uint64)
     with np.errstate(over="ignore"):
-        h = np.uint64(seed) + _P5 + np.uint64(8)
+        h = np.asarray(seed, dtype=np.uint64) + _P5 + np.uint64(8)
         k = _rotl(values * _P2, 31) * _P1
         h = h ^ k
         h = _rotl(h, 27) * _P1 + _P4
@@ -134,6 +135,75 @@ def xxhash64_strings(values: np.ndarray, seed: int = DEFAULT_SEED) -> np.ndarray
     return out
 
 
+# ---------------------------------------------------------------------------
+# Device-side (jnp) hashing — the frequency engine's group keys. Requires
+# x64 mode (uint64 arrays); the runner gates the device frequency path on it.
+# ---------------------------------------------------------------------------
+
+#: the key value reserved for masked-out/null rows in the device frequency
+#: engine: sorts AFTER every real key, so compactions and drains drop it
+#: structurally. Real keys that land on it are counted exactly in the
+#: state's ``sent_rows`` scalar instead.
+FREQ_KEY_SENTINEL = 0xFFFFFFFFFFFFFFFF
+
+
+def splitmix64_jnp(v):
+    """SplitMix64 finalizer over a uint64 jnp array — a BIJECTIVE avalanche
+    (Steele et al., the JDK SplittableRandom mixer). Integral/boolean
+    grouping columns derive their device frequency keys through this ON
+    DEVICE from the shared ``num`` feature (zero host hashing): a bijection
+    has ZERO collisions, so the device frequency table's count multiset
+    equals the host group-by's exactly, not just overwhelmingly-probably —
+    and the avalanche spreads sequential ids uniformly, which keeps the
+    host drain's radix partitions (native ``u64_value_counts``) balanced."""
+    import jax.numpy as jnp
+
+    v = v ^ (v >> jnp.uint64(30))
+    v = v * jnp.uint64(0xBF58476D1CE4E5B9)
+    v = v ^ (v >> jnp.uint64(27))
+    v = v * jnp.uint64(0x94D049BB133111EB)
+    v = v ^ (v >> jnp.uint64(31))
+    return v
+
+
+def splitmix64(v: np.ndarray) -> np.ndarray:
+    """numpy twin of :func:`splitmix64_jnp` (bit-identical) — what parity
+    tests and host-side key reconstruction fold integral columns through."""
+    v = np.ascontiguousarray(v, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        v = v ^ (v >> np.uint64(30))
+        v = v * np.uint64(0xBF58476D1CE4E5B9)
+        v = v ^ (v >> np.uint64(27))
+        v = v * np.uint64(0x94D049BB133111EB)
+        v = v ^ (v >> np.uint64(31))
+    return v
+
+
+def xxhash64_u64_jnp(values, seed):
+    """xxHash64 of 8-byte inputs as jnp uint64 ops — bit-identical to the
+    numpy :func:`xxhash64_u64` (same constants, same rotations). ``seed``
+    may be a scalar or a per-row uint64 array, which is how multi-column
+    grouping sets chain their combined key: Spark's XxHash64 feeds each
+    column's hash as the next column's seed
+    (`catalyst/expressions/hash.scala`), and the device engine mirrors
+    that so a combined key depends on every column and on column order."""
+    import jax.numpy as jnp
+
+    u = lambda x: jnp.uint64(x)  # noqa: E731
+    h = seed + u(_P5) + u(8)
+    k = values * u(_P2)
+    k = (k << u(31)) | (k >> u(33))
+    k = k * u(_P1)
+    h = h ^ k
+    h = ((h << u(27)) | (h >> u(37))) * u(_P1) + u(_P4)
+    h = h ^ (h >> u(33))
+    h = h * u(_P2)
+    h = h ^ (h >> u(29))
+    h = h * u(_P3)
+    h = h ^ (h >> u(32))
+    return h
+
+
 def hash_column(values: np.ndarray, mask: np.ndarray, kind, seed: int = DEFAULT_SEED) -> np.ndarray:
     """Hash a column to u64, matching Spark's per-type byte layout:
     integrals as int64 LE, fractionals as IEEE754 double bits (with -0.0
@@ -147,8 +217,13 @@ def hash_column(values: np.ndarray, mask: np.ndarray, kind, seed: int = DEFAULT_
         return xxhash64_u64(as_u64, seed)
     if kind == ColumnKind.INTEGRAL:
         return xxhash64_u64(values.astype(np.int64).view(np.uint64), seed)
-    # fractional: double bits, normalize -0.0
+    # fractional: double bits, normalize -0.0 and NaN. Java's
+    # Double.doubleToLongBits (what Spark's XxHash64 hashes) collapses
+    # every NaN payload to the canonical quiet NaN, and pandas' groupby
+    # keys all NaNs as one group — so the device frequency engine's hashed
+    # keys agree with the host group-by on NaN-valued rows too.
     vals = values.astype(np.float64, copy=True)
     vals[vals == 0.0] = 0.0  # -0.0 -> 0.0
+    vals[np.isnan(vals)] = np.nan
     vals[~mask] = 0.0
     return xxhash64_u64(vals.view(np.uint64), seed)

@@ -290,6 +290,19 @@ def _attempt_tiered(
             )
         except Exception as exc:  # noqa: BLE001 - ladder decides
             kind = classify_failure(exc)
+            if kind == "oom" and oom_left > 0 and _oom_bisection_futile(part, bs):
+                # halving the batch shrinks the live FEATURE buffers but
+                # never a device frequency table's fixed-shape
+                # (slots + buffer) footprint — when the tables dominate
+                # the partition's device memory, bisection re-passes are
+                # pure waste; fall through to failover/battery-bisection
+                # (which isolates the table scans so the runner's host
+                # accumulator fallback takes the set)
+                oom_left = 0
+                _logger.warning(
+                    "device OOM with frequency-table states dominating the "
+                    "footprint; skipping futile batch bisection"
+                )
             if (
                 kind == "oom"
                 and oom_left > 0
@@ -329,6 +342,24 @@ def _attempt_tiered(
                 host_states = _refresh_host_states(host_states, monitor)
                 continue
             raise
+
+
+def _oom_bisection_futile(part: Tuple, batch_size: int) -> bool:
+    """Whether an OOM cannot be relieved by halving the batch: the
+    partition's device frequency TABLES (fixed-shape sorted table + key
+    buffer, sized by ``slots``/``buffer_entries``, not by the batch)
+    already outweigh the reclaimable per-batch feature bytes (~8B per row
+    per analyzer, all of which a halving could at best free)."""
+    from ..analyzers.grouping import DeviceFrequencyTableScan
+
+    tables = [a for a in part if isinstance(a, DeviceFrequencyTableScan)]
+    if not tables:
+        return False
+    table_bytes = sum(
+        24 * a.slots + 8 * a.buffer_entries for a in tables
+    )
+    reclaimable = 8 * batch_size * max(1, len(part))
+    return table_bytes > reclaimable
 
 
 def _refresh_host_states(host_states: Dict[Any, Any], monitor) -> Dict[Any, Any]:

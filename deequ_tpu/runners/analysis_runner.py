@@ -146,9 +146,16 @@ class AnalysisRunner:
 
         # split: device-fused scan / grouping sets / host accumulators
         from ..analyzers.grouping import (
-            DEVICE_FREQ_MAX_CARDINALITY,
             DeviceFrequencyScan,
+            DeviceFrequencyTableScan,
+            ScanShareableFrequencyBasedAnalyzer,
+            device_freq_enabled,
+            device_freq_max_cardinality,
+            plan_table_scan,
+            probably_low_cardinality,
         )
+
+        dict_card_limit = device_freq_max_cardinality()
 
         # host-exclusive analyzers (e.g. exact-quantile mode, whose
         # accumulator is unbounded and has no fixed-shape device fold) opt
@@ -187,7 +194,7 @@ class AnalysisRunner:
             if isinstance(a, Histogram)
             and a.binning_func is None
             and (size := data.dictionary_size(a.column)) is not None
-            and size <= DEVICE_FREQ_MAX_CARDINALITY
+            and size <= dict_card_limit
         ]
         device_hist_set = set(device_hist)
         host_accum = [
@@ -213,8 +220,7 @@ class AnalysisRunner:
 
         # single-column grouping sets over dictionary-encoded columns whose
         # dictionary is small ride the fused DEVICE scan as a segment_sum
-        # (SURVEY §7 step 6's low-cardinality hybrid); everything else
-        # accumulates through the amortized host group-by
+        # (SURVEY §7 step 6's low-cardinality hybrid)
         device_freq: Dict[Tuple[str, ...], DeviceFrequencyScan] = {}
         device_dicts: Dict[Tuple[str, ...], Any] = {}
         for cols in list(grouping_sets) + [(a.column,) for a in device_hist]:
@@ -222,7 +228,7 @@ class AnalysisRunner:
                 continue
             if len(cols) == 1:
                 dictionary = data.dictionary_values(cols[0])
-                if dictionary is not None and len(dictionary) <= DEVICE_FREQ_MAX_CARDINALITY:
+                if dictionary is not None and len(dictionary) <= dict_card_limit:
                     device_freq[cols] = DeviceFrequencyScan(cols[0], len(dictionary))
                     device_dicts[cols] = dictionary
         # a histogram column whose dictionary out-sizes the device path
@@ -233,6 +239,60 @@ class AnalysisRunner:
                 host_accum.append(a)
         device_hist = [a for a in device_hist if a in device_hist_set]
 
+        # every OTHER grouping set rides the device frequency TABLE engine
+        # (hashed fixed-shape count tables folded in the fused pass,
+        # ROADMAP item 3) when it safely can:
+        #  - every member reduces the COUNT MULTISET alone (Histogram /
+        #    MutualInformation read keys and stay on the dict/host paths);
+        #  - nothing downstream needs value-keyed states — no persistence,
+        #    aggregation or checkpointing (hashed tables and value-keyed
+        #    host states must never merge);
+        #  - x64 is on (uint64 keys) and the pass will run the DEVICE tier
+        #    (on a feed-starved link streaming 8B/row of raw keys loses to
+        #    the in-place host group-by).
+        # Overflowing tables fall back per set after the pass; the host
+        # accumulator (and its _SpillStore) is the last-resort tier.
+        import jax as _jax
+
+        from .engine import effective_batch_size as _ebs
+
+        slim = (
+            aggregate_with is None
+            and save_states_with is None
+            and checkpointer is None
+        )
+        table_freq: Dict[Tuple[str, ...], DeviceFrequencyTableScan] = {}
+        if (
+            slim
+            and grouping_sets
+            and device_freq_enabled()
+            and _jax.config.jax_enable_x64
+            and _device_tier_expected(scanning, placement)
+        ):
+            batch_rows = _ebs(data, batch_size)
+            if sharding is not None:
+                n_dev = int(sharding.devices.size)
+                batch_rows = ((batch_rows + n_dev - 1) // n_dev) * n_dev
+            for cols, members in grouping_sets.items():
+                if cols in device_freq:
+                    continue
+                if not all(
+                    isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+                    for a in members
+                ):
+                    continue
+                if probably_low_cardinality(data, cols):
+                    # below the sweep knee the host value_counts fast
+                    # path beats the device table ~3x — keep the
+                    # pre-engine routing for confidently-small sets
+                    continue
+                scan = plan_table_scan(
+                    schema, cols, int(data.num_rows), batch_rows,
+                    sharded=sharding is not None,
+                )
+                if scan is not None:
+                    table_freq[cols] = scan
+
         # one shared pass over the data — executed through the reliability
         # layer: a device-infrastructure failure fails the battery over to
         # the host tier (OOMs first bisect the batch size), and an
@@ -240,14 +300,18 @@ class AnalysisRunner:
         # analyzers degrade to typed Failure metrics while the rest
         # complete (the fused-engine restoration of the reference's
         # per-expression degradation, `AnalysisRunner.scala:320-323`)
-        scan_battery = scanning + list(device_freq.values())
+        scan_battery = (
+            scanning + list(device_freq.values()) + list(table_freq.values())
+        )
         run_monitor = monitor or RunMonitor()
+        if table_freq:
+            run_monitor.bump("device_freq_sets", len(table_freq))
 
         def make_host_states():
             hs: Dict[Any, Any] = {}
             hu: Dict[Any, Any] = {}
             for cols in grouping_sets:
-                if cols in device_freq:
+                if cols in device_freq or cols in table_freq:
                     continue
                 key = ("__grouping__", cols)
                 hs[key] = FrequenciesAndNumRows.empty(list(cols))
@@ -265,15 +329,11 @@ class AnalysisRunner:
             from .engine import effective_batch_size
 
             full_battery = tuple(scan_battery)
-            # slim fetch: when nothing downstream needs the full states
-            # (no persistence, no cross-run aggregation, no checkpoint),
-            # each analyzer ships only its metric-bearing leaves back over
-            # the feed link (engine._fetch_states_packed's analyzers arg)
-            slim = (
-                aggregate_with is None
-                and save_states_with is None
-                and checkpointer is None
-            )
+            # slim fetch (the hoisted ``slim``): when nothing downstream
+            # needs the full states (no persistence, no cross-run
+            # aggregation, no checkpoint), each analyzer ships only its
+            # metric-bearing leaves back over the feed link
+            # (engine._fetch_states_packed's analyzers arg)
 
             def run_pass(part, hs, hu, *, placement=None, batch_size=None):
                 engine = ScanEngine(
@@ -301,6 +361,59 @@ class AnalysisRunner:
                 placement=placement,
             )
 
+            # drain the device frequency tables. A set whose table
+            # overflowed (compactions dropped groups — drain returns None)
+            # or whose scan degraded re-runs through the host accumulator
+            # in ONE dedicated last-resort pass; _SpillStore sits below
+            # that, exactly the old default path, now reached only when
+            # the device tiers are exhausted.
+            table_shared: Dict[Tuple[str, ...], Any] = {}
+            fallback_states: Dict[Any, Any] = {}
+            fallback_errors: Dict[Any, BaseException] = {}
+            fallback_sets: List[Tuple[str, ...]] = []
+            fallback_losses: List[str] = []
+            if table_freq:
+                for cols, scan in table_freq.items():
+                    state = outcome.states.get(scan)
+                    drained = None if state is None else scan.drain(state)
+                    if drained is None:
+                        fallback_sets.append(cols)
+                        if state is not None:
+                            run_monitor.bump("freq_overflow_fallbacks")
+                            fallback_losses.append(
+                                f"{cols}: ~{int(state.lost_groups)} groups / "
+                                f"{int(state.lost_rows)} rows dropped"
+                            )
+                        else:
+                            fallback_losses.append(f"{cols}: pass degraded")
+                    else:
+                        table_shared[cols] = drained
+            if fallback_sets:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "device frequency table overflowed (or degraded) for "
+                    "grouping sets [%s]; re-running them through the host "
+                    "accumulator tier", "; ".join(fallback_losses),
+                )
+
+                def make_fallback_states():
+                    hs: Dict[Any, Any] = {}
+                    hu: Dict[Any, Any] = {}
+                    for cols in fallback_sets:
+                        key = ("__grouping__", cols)
+                        hs[key] = FrequenciesAndNumRows.empty(list(cols))
+                        hu[key] = lambda st, batch: st.update(batch)
+                    return hs, hu
+
+                fb = run_scan_resilient(
+                    run_pass, (), make_fallback_states, run_monitor,
+                    batch_size=effective_batch_size(data, batch_size),
+                    placement=placement,
+                )
+                fallback_states = fb.host_states
+                fallback_errors = fb.host_errors
+
             # scanning analyzers: load old state -> merge -> persist -> metric
             # (reference `Analyzer.calculateMetric`, `Analyzer.scala:107-128`)
             # — a monitored phase, so state-merge/persist/metric cost is
@@ -320,8 +433,8 @@ class AnalysisRunner:
 
                 def shared_frequencies(cols):
                     """The grouping state for ``cols``, or the typed error
-                    that took its producer down (device scan or host
-                    accumulator)."""
+                    that took its producer down (device scan, device
+                    frequency table, or host accumulator)."""
                     if cols in device_freq:
                         scan = device_freq[cols]
                         if device_freq_states[cols] is None:
@@ -331,6 +444,15 @@ class AnalysisRunner:
                                 device_freq_states[cols], device_dicts[cols]
                             ),
                             None,
+                        )
+                    if cols in table_freq:
+                        if cols in table_shared:
+                            return table_shared[cols], None
+                        key = ("__grouping__", cols)
+                        if key in fallback_states:
+                            return fallback_states[key], None
+                        return None, fallback_errors.get(
+                            key, outcome.errors.get(table_freq[cols])
                         )
                     key = ("__grouping__", cols)
                     if key in outcome.host_errors:
@@ -373,6 +495,19 @@ class AnalysisRunner:
                     metrics[a] = _finalize(
                         a, shared, aggregate_with, save_states_with
                     )
+            if slim:
+                # explicit spill-dir cleanup: pass-local grouping/histogram
+                # tables are dead once their metrics are derived — release
+                # any _SpillStore directory NOW instead of at GC time. A
+                # non-slim run may have handed the state OBJECT to a
+                # persister (InMemoryStateProvider keeps the reference), so
+                # those rely on the GC finalizer backstop.
+                for st in (
+                    *outcome.host_states.values(),
+                    *fallback_states.values(),
+                ):
+                    if isinstance(st, FrequenciesAndNumRows):
+                        st.close()
         for a in others:
             metrics[a] = a.to_failure_metric(
                 MetricCalculationException(f"No execution strategy for analyzer {a}")
@@ -448,6 +583,34 @@ def _finalize(
         return analyzer.compute_metric_from(state)
     except Exception as exc:  # noqa: BLE001
         return analyzer.to_failure_metric(exc)
+
+
+def _device_tier_expected(scanning, placement) -> bool:
+    """Whether the shared pass will stream batches to the DEVICE tier —
+    the gate for the device frequency table engine (its raw per-row hash
+    keys cost ~8B/row/column on the feed link; on a host-tier pass the
+    in-place group-by is strictly better). Delegates to the engine's own
+    ``resolve_scan_placement`` so the gate can never drift from where the
+    pass actually runs."""
+    import os
+
+    from .engine import (
+        _FEED_BANDWIDTH_THRESHOLD_MBPS,
+        probe_feed_bandwidth,
+        resolve_scan_placement,
+    )
+
+    if scanning:
+        return resolve_scan_placement(scanning, placement) == "device"
+    # no scan battery to ride: adding the (device-only) frequency scans
+    # would CREATE a device pass, which only pays off when the feed link
+    # is fast or the caller explicitly asked for the device tier
+    effective = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+    if effective == "host":
+        return False
+    if effective == "device":
+        return True
+    return probe_feed_bandwidth() >= _FEED_BANDWIDTH_THRESHOLD_MBPS
 
 
 def _columns_needed(engine: ScanEngine, grouping_sets, host_accum, schema) -> Optional[List[str]]:

@@ -98,6 +98,12 @@ class RunMonitor:
     #: batches a bundle dispatch is bracketed by block_until_ready, so its
     #: measured time is true execution, not enqueue)
     cost_probes: int = 0
+    #: grouping sets that rode the device frequency TABLE engine this run
+    #: (hashed fixed-shape count tables in the fused pass; ROADMAP item 3)
+    device_freq_sets: int = 0
+    #: device frequency tables whose compactions dropped groups — those
+    #: sets re-ran through the host accumulator last-resort tier
+    freq_overflow_fallbacks: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -120,6 +126,8 @@ class RunMonitor:
         self.cost_by_analyzer = {}
         self.bundle_dispatch_seconds = 0.0
         self.cost_probes = 0
+        self.device_freq_sets = 0
+        self.freq_overflow_fallbacks = 0
 
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
@@ -292,6 +300,12 @@ class PackedScanProgram:
                 donate_argnums=0,
             )
         self._unpack_jit = jax.jit(unpack)
+        # pass-END unpack: the carry is dead afterwards, so donating it
+        # lets the pass-through (aux) leaves alias instead of copy — a
+        # resident frequency buffer is hundreds of MB, and the identity
+        # copy was measurable (~0.26s at 256MB on CPU). NEVER use for the
+        # mid-pass checkpoint unpack, whose carry keeps folding.
+        self._unpack_final_jit = jax.jit(unpack, donate_argnums=0)
         self._init_jit = jax.jit(
             lambda: pack(tuple(a.init_state() for a in analyzers))
         )
@@ -346,6 +360,21 @@ class PackedScanProgram:
     def unpack(self, carry) -> Tuple:
         """Packed carry -> ordinary per-analyzer state pytrees (on device)."""
         return self._unpack_jit(carry)
+
+    def unpack_final(self, carry) -> Tuple:
+        """Like :meth:`unpack` but DONATES the carry (pass-end only: the
+        carry must not be dispatched again)."""
+        import warnings
+
+        with warnings.catch_warnings():
+            # the stacked fvec/ivec leaves change dtype on unpack, so jax
+            # reports their donated buffers as unusable — expected; the
+            # donation exists for the pass-through aux leaves (a resident
+            # frequency buffer is hundreds of MB)
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return self._unpack_final_jit(carry)
 
     def pack_states(self, states: Tuple):
         """Ordinary per-analyzer state pytrees -> packed carry; the inverse
@@ -607,9 +636,17 @@ class BundledScanProgram:
         """Per-analyzer state pytrees in battery order (pad slots, which
         re-folded a duplicate of their bundle's first analyzer, are
         discarded)."""
+        return self._unpack(carry, final=False)
+
+    def unpack_final(self, carry) -> Tuple:
+        """Pass-end variant: donates each bundle's carry (it must not be
+        dispatched again) so pass-through leaves alias instead of copy."""
+        return self._unpack(carry, final=True)
+
+    def _unpack(self, carry, final: bool) -> Tuple:
         out: List[Any] = [None] * len(self.analyzers)
         for (idxs, n_real), prog, c in zip(self._bundles, self._programs, carry):
-            states = prog.unpack(c)
+            states = prog.unpack_final(c) if final else prog.unpack(c)
             for j in range(n_real):
                 out[idxs[j]] = states[j]
         return tuple(out)
@@ -794,6 +831,28 @@ def _pack_leaves_f64(leaves):
 
 
 @jax.jit
+def _pack_leaves_u64_u8(leaves):
+    """x64-mode packing of 8-byte UNSIGNED leaves — the frequency engine's
+    full-range u64 hash keys, which the f64 upcast path would corrupt above
+    2^53. Each leaf splits into (lo, hi) uint32 halves and ships through
+    the bit-exact u8 bitcast (the TPU x64-emulation rewriter implements no
+    64-bit bitcasts; 32-bit ones it does). Per group the layout is one
+    lo-block then one hi-block, grouped leaf order."""
+    parts = []
+    for idxs in _group_leaves(leaves).values():
+        grp = [leaves[i] for i in idxs]
+        stacked = grp[0] if len(grp) == 1 else jnp.stack(grp)
+        lo = (stacked & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (stacked >> jnp.uint64(32)).astype(jnp.uint32)
+        parts.append(
+            jnp.ravel(
+                jax.lax.bitcast_convert_type(jnp.stack([lo, hi]), jnp.uint8)
+            )
+        )
+    return jnp.concatenate(parts)
+
+
+@jax.jit
 def _pack_leaves_u8(leaves):
     """32-bit-mode packing (grouped leaf order): bitcast each (<=32-bit)
     leaf to raw bytes — bit-exact, and int32 values above f32's 2^24
@@ -820,6 +879,11 @@ def _empty_batch_like(data: Dataset, columns):
 #: below this many narrow bytes the second transfer's round trip costs more
 #: than the f64 upcast wastes
 _NARROW_SPLIT_BYTES = 1 << 15
+
+#: leaves at least this big skip the packed-transfer paths and transfer
+#: directly (one leaf = one transfer; the repack's extra full-buffer
+#: copies dominate at resident-key-buffer sizes)
+_DIRECT_LEAF_BYTES = 4 << 20
 
 
 def _slim_kll_for_fetch(states: Tuple) -> Tuple[Tuple, List[Optional[int]]]:
@@ -1092,6 +1156,27 @@ def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
             out_leaves[i] = host.reshape(leaf.shape).copy()
             offset += leaf.size * dtype.itemsize
 
+    def unpack_u64(idx: List[int], raw: bytes) -> None:
+        # inverse of _pack_leaves_u64_u8: per (shape, dtype) group, one
+        # lo-u32 block then one hi-u32 block covering the whole group
+        offset = 0
+        for grp in _group_leaves(leaves, idx).values():
+            n = sum(leaves[i].size for i in grp)
+            lo = np.frombuffer(raw, dtype=np.uint32, count=n, offset=offset)
+            offset += 4 * n
+            hi = np.frombuffer(raw, dtype=np.uint32, count=n, offset=offset)
+            offset += 4 * n
+            vals = lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
+            at = 0
+            for i in grp:
+                leaf = leaves[i]
+                out_leaves[i] = (
+                    vals[at : at + leaf.size]
+                    .astype(np.dtype(leaf.dtype.name))
+                    .reshape(leaf.shape)
+                )
+                at += leaf.size
+
     def start_d2h(arr):
         # kick off the device->host copy without blocking, so a second
         # packed buffer's transfer (and any remaining host work) overlaps
@@ -1107,13 +1192,57 @@ def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
         unpack_u8(_grouped_leaf_order(leaves), np.asarray(start_d2h(_pack_leaves_u8(leaves))).tobytes())
         return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
-    narrow = [i for i, l in enumerate(leaves) if l.dtype.itemsize <= 4]
+    # HUGE leaves (a resident frequency key buffer is hundreds of MB, its
+    # count table tens) transfer DIRECTLY: one leaf is one transfer anyway,
+    # and skipping the stack/convert/repack round-trips saves several
+    # full-buffer copies per side (on the CPU backend np.asarray of the
+    # leaf is zero-copy: measured 2.3s packed -> ~0s direct for a 256MB
+    # buffer). The packed paths exist to batch MANY SMALL leaves into few
+    # transfers — past _DIRECT_LEAF_BYTES a leaf is its own bulk transfer.
+    direct = [
+        i for i, l in enumerate(leaves)
+        if l.size * l.dtype.itemsize >= _DIRECT_LEAF_BYTES
+    ]
+    for i in direct:
+        start_d2h(leaves[i])  # kick the D2H copy early; harvested below
+    # remaining 8-byte UNSIGNED leaves (u64 hash keys) must never ride the
+    # f64 upcast — values above 2^53 would round; they get the split-to-u32
+    # bit-exact transfer. (int64 counters stay on the f64 path: they hold
+    # row counts, far below 2^53 — the documented contract.)
+    wide_u64 = [
+        i for i, l in enumerate(leaves)
+        if i not in set(direct)
+        and l.dtype.itemsize == 8
+        and np.dtype(l.dtype.name).kind == "u"
+    ]
+    packed_u64 = (
+        start_d2h(_pack_leaves_u64_u8([leaves[i] for i in wide_u64]))
+        if wide_u64
+        else None
+    )
+    rest = [
+        i for i in range(len(leaves))
+        if i not in set(direct) and i not in set(wide_u64)
+    ]
+
+    def unpack_direct() -> None:
+        for i in direct:
+            out_leaves[i] = np.asarray(leaves[i])
+
+    narrow = [i for i in rest if leaves[i].dtype.itemsize <= 4]
     narrow_bytes = sum(leaves[i].size * leaves[i].dtype.itemsize for i in narrow)
     if narrow_bytes < _NARROW_SPLIT_BYTES:
-        unpack_f64(_grouped_leaf_order(leaves), np.asarray(start_d2h(_pack_leaves_f64(leaves))))
+        if rest:
+            unpack_f64(
+                _grouped_leaf_order(leaves, rest),
+                np.asarray(start_d2h(_pack_leaves_f64([leaves[i] for i in rest]))),
+            )
+        if packed_u64 is not None:
+            unpack_u64(wide_u64, np.asarray(packed_u64).tobytes())
+        unpack_direct()
         return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
-    wide = [i for i in range(len(leaves)) if i not in set(narrow)]
+    wide = [i for i in rest if i not in set(narrow)]
     packed_narrow = start_d2h(_pack_leaves_u8([leaves[i] for i in narrow]))
     packed_wide = (
         start_d2h(_pack_leaves_f64([leaves[i] for i in wide])) if wide else None
@@ -1123,6 +1252,9 @@ def _fetch_states_packed_raw(states: Tuple) -> List[Any]:
     unpack_u8(_grouped_leaf_order(leaves, narrow), np.asarray(packed_narrow).tobytes())
     if packed_wide is not None:
         unpack_f64(_grouped_leaf_order(leaves, wide), np.asarray(packed_wide))
+    if packed_u64 is not None:
+        unpack_u64(wide_u64, np.asarray(packed_u64).tobytes())
+    unpack_direct()
     return list(jax.tree_util.tree_unflatten(treedef, out_leaves))
 
 
@@ -1178,6 +1310,39 @@ def probe_feed_latency() -> float:
     """Round-trip latency (seconds) of the feed link; probes on first use."""
     probe_feed_bandwidth()
     return _FEED_LATENCY_S if _FEED_LATENCY_S is not None else 0.0
+
+
+def resolve_scan_placement(scan_analyzers, placement, monitor=None) -> str:
+    """THE ingest-tier decision for a fused scan pass: "device" streams
+    batches to the accelerator, "host" folds per-analyzer partials in a
+    thread pool. Module-level (not a method) because the runner's
+    device-frequency eligibility gate must ask the same question BEFORE
+    an engine exists — one copy means the two can never drift.
+
+    - a battery with any device-only analyzer (no host partial) streams
+      to the device regardless of the requested placement
+    - explicit "host"/"device" placements are honored otherwise
+    - "auto" probes the feed link: below the bandwidth threshold, host
+      partials win (composes with a mesh: _run_host_tier shards the fold
+      over the devices — streaming raw columns over a slow feed would
+      starve ALL chips at once)
+    """
+    import os
+
+    effective = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+    if not scan_analyzers:
+        return "device"
+    if not all(a.supports_host_partial for a in scan_analyzers):
+        return "device"
+    if effective == "host":
+        return "host"
+    if effective == "auto":
+        bw = probe_feed_bandwidth()
+        if monitor is not None:
+            monitor.feed_bandwidth_mbps = bw
+        if bw < _FEED_BANDWIDTH_THRESHOLD_MBPS:
+            return "host"
+    return "device"
 
 
 class _DeviceFeatureCache:
@@ -1494,21 +1659,9 @@ class ScanEngine:
         return placement
 
     def _resolve_placement_inner(self) -> str:
-        if not self.scan_analyzers:
-            return "device"
-        if not all(a.supports_host_partial for a in self.scan_analyzers):
-            return "device"
-        if self.placement == "host":
-            return "host"
-        if self.placement == "auto":
-            bw = probe_feed_bandwidth()
-            self.monitor.feed_bandwidth_mbps = bw
-            if bw < _FEED_BANDWIDTH_THRESHOLD_MBPS:
-                # composes with a mesh: host partials then shard the fold
-                # over the devices (_run_host_tier) — streaming raw columns
-                # over a slow feed would starve ALL chips at once
-                return "host"
-        return "device"
+        return resolve_scan_placement(
+            self.scan_analyzers, self.placement, self.monitor
+        )
 
     def required_columns(self) -> List[str]:
         return self.builder.required_columns
@@ -1829,7 +1982,8 @@ class ScanEngine:
             # profile read as fetch-bound when it was not)
             with monitor.timed("device_dispatch"):
                 jax.block_until_ready(jax.tree_util.tree_leaves(carry))
-            states = self._update.unpack(carry)
+            states = self._update.unpack_final(carry)
+            carry = None  # donated — it must never be touched again
         compiled = compiled_count()
         with _MONITOR_LOCK:
             monitor.jit_compiles = max(monitor.jit_compiles, compiled)

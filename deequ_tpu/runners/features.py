@@ -299,7 +299,17 @@ class FeatureBuilder:
                     )
             elif spec.kind == "hash":
                 col = batch.column(spec.column)
-                features[key] = hash_column(col.values, col.mask, col.kind)
+                if _is_string_dict(col):
+                    # gather from the per-dataset cached DISTINCT-value
+                    # hashes (masked rows carry arbitrary hashes — the
+                    # frequency engine sentinel-keys them before use)
+                    features[key] = dict_hashes(col)
+                elif col.kind == ColumnKind.STRING:
+                    features[key] = hash_column(
+                        col.string_source, col.mask, col.kind
+                    )
+                else:
+                    features[key] = hash_column(col.values, col.mask, col.kind)
             elif spec.kind == "hll":
                 features[key] = _hll_packed(batch.column(spec.column))
             elif spec.kind == "codes":

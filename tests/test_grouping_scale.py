@@ -81,6 +81,11 @@ class TestAmortizedAccumulation:
         assert work <= 10 * n, work
 
     def test_budget_enforced_as_failure_metric_when_spill_disabled(self, monkeypatch):
+        # budget semantics belong to the HOST accumulator tier — the device
+        # frequency table engine (the default route for this set since
+        # ROADMAP item 3 landed) has its own overflow tiering and would
+        # compute this exactly without ever touching the budget
+        monkeypatch.setenv("DEEQU_TPU_DEVICE_FREQ", "0")
         monkeypatch.setenv("DEEQU_TPU_MAX_FREQUENCY_ENTRIES", "1000")
         monkeypatch.setenv("DEEQU_TPU_FREQUENCY_SPILL", "0")
         data = Dataset.from_dict({"k": np.arange(200_000) % 150_000})

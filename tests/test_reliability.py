@@ -232,9 +232,13 @@ class TestAnalyzerIsolation:
         failures = [a for a, m in ctx.metric_map.items() if m.value.is_failure]
         assert failures == [target]
 
-    def test_host_accumulator_knockout_spares_battery(self):
+    def test_host_accumulator_knockout_spares_battery(self, monkeypatch):
         from deequ_tpu.analyzers import grouping as grouping_mod
 
+        # pin the grouping set onto the HOST accumulator tier whose
+        # knockout path this test exercises — by default the set rides the
+        # device frequency table engine and the poison never fires
+        monkeypatch.setenv("DEEQU_TPU_DEVICE_FREQ", "0")
         calls = {"n": 0}
         original = grouping_mod.FrequenciesAndNumRows.update
 

@@ -1,0 +1,185 @@
+"""Grouping-engine sweep: device frequency table vs host group-by/spill.
+
+Measures rows/s, peak RSS and metric parity for the grouping analyzers
+(Uniqueness / CountDistinct / Entropy) across distinct-key counts — the
+before/after evidence for ROADMAP item 3 (the PERF.md "Grouping engine"
+table and the bench ``grouping`` stage both come from here).
+
+Every measured point runs in a FRESH subprocess so ``ru_maxrss`` is the
+point's own peak, not the sweep driver's high-water mark; the parent
+compares the two engines' metric JSON for bit-exact equality (python
+float repr round-trips exactly through json).
+
+Usage:
+  python -m tools.grouping_sweep                      # default sweep
+  python -m tools.grouping_sweep --rows 25000000 --distinct 3571428
+  python -m tools.grouping_sweep --markdown           # PERF.md rows
+  python -m tools.grouping_sweep --point --rows N --distinct D \
+      --engine device|host                            # one in-process point
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BATTERY_COLS = ["k"]
+
+
+def measure_point(rows: int, distinct: int, engine: str, seed: int = 1) -> dict:
+    """One in-process measurement. ``engine="device"`` routes through the
+    device frequency table (placement=device); ``engine="host"`` pins the
+    pre-engine default: host group-by accumulator (+ _SpillStore when the
+    budget forces it), placement=host."""
+    if engine == "device":
+        os.environ.pop("DEEQU_TPU_DEVICE_FREQ", None)
+        # measure the raw table curve: without this, low-cardinality
+        # points would be silently re-routed to the host group-by by the
+        # pre-routing probe and the sweep would compare host against host
+        os.environ["DEEQU_TPU_FREQ_HOST_ROUTE"] = "0"
+        placement = "device"
+    elif engine == "host":
+        os.environ["DEEQU_TPU_DEVICE_FREQ"] = "0"
+        placement = "host"
+    else:
+        raise SystemExit(f"unknown engine {engine!r}")
+
+    import numpy as np
+
+    from deequ_tpu.analyzers import CountDistinct, Entropy, Uniqueness
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.engine import RunMonitor
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, distinct, rows)
+    data = Dataset.from_dict({"k": keys})
+    battery = [Uniqueness(["k"]), CountDistinct(["k"]), Entropy("k")]
+
+    # compile warm-up, then measure the warm rate (the bench convention
+    # for device stages). The device path warms on the FULL dataset: the
+    # frequency-table state shapes (slots, buffer) are sized from the run's
+    # row count, so a smaller warm-up would compile the wrong program and
+    # the timed run would measure XLA compile, not throughput. The host
+    # path has no shape-dependent compile — a small slice warms its
+    # allocator pools.
+    if engine == "device":
+        warm = data
+    else:
+        warm = Dataset.from_dict({"k": keys[: min(rows, 1 << 20)]})
+    AnalysisRunner.do_analysis_run(
+        warm, battery, batch_size=1 << 20, placement=placement
+    )
+
+    mon = RunMonitor()
+    t0 = time.perf_counter()
+    ctx = AnalysisRunner.do_analysis_run(
+        data, battery, batch_size=1 << 20, placement=placement, monitor=mon
+    )
+    elapsed = time.perf_counter() - t0
+    peak_rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    metrics = {a.name: ctx.metric(a).value.get() for a in battery}
+    return {
+        "engine": engine,
+        "rows": rows,
+        "distinct_requested": distinct,
+        "distinct": metrics["CountDistinct"],
+        "seconds": round(elapsed, 3),
+        "rows_per_sec": round(rows / elapsed, 1),
+        "peak_rss_gb": round(peak_rss_gb, 3),
+        "device_freq_sets": mon.device_freq_sets,
+        "freq_overflow_fallbacks": mon.freq_overflow_fallbacks,
+        "metrics": metrics,
+    }
+
+
+def subprocess_point(
+    rows: int, distinct: int, engine: str, seed: int = 1,
+    timeout: float = 900.0, extra_env: dict = None,
+) -> dict:
+    """Measure one point in a fresh process (clean ru_maxrss). THE one copy
+    of the point-subprocess protocol — bench.py's grouping stage calls this
+    too, so CLI flags / output format can never drift between the two."""
+    cmd = [
+        sys.executable, "-m", "tools.grouping_sweep", "--point",
+        "--rows", str(rows), "--distinct", str(distinct),
+        "--engine", engine, "--seed", str(seed),
+    ]
+    env = dict(os.environ)
+    env.pop("DEEQU_TPU_DEVICE_FREQ", None)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"grouping point {engine} rows={rows} distinct={distinct} "
+            f"failed (rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def sweep(rows: int, distincts: list, markdown: bool, seed: int) -> None:
+    points = []
+    for d in distincts:
+        dev = subprocess_point(rows, d, "device", seed)
+        host = subprocess_point(rows, d, "host", seed)
+        exact = dev["metrics"] == host["metrics"]
+        points.append((d, dev, host, exact))
+        print(
+            f"distinct={d:>9,}  device {dev['rows_per_sec']/1e6:7.2f}M rows/s "
+            f"rss {dev['peak_rss_gb']:5.2f}GB (fallbacks="
+            f"{dev['freq_overflow_fallbacks']})  |  host "
+            f"{host['rows_per_sec']/1e6:7.2f}M rows/s rss "
+            f"{host['peak_rss_gb']:5.2f}GB  |  x"
+            f"{dev['rows_per_sec']/host['rows_per_sec']:.1f} "
+            f"{'bit-exact' if exact else 'METRIC MISMATCH!'}",
+            file=sys.stderr, flush=True,
+        )
+        if not exact:
+            raise SystemExit(f"metric mismatch at distinct={d}: {dev['metrics']} != {host['metrics']}")
+    if markdown:
+        print("| distinct keys | device rows/s | device peak RSS | host rows/s | host peak RSS | speedup |")
+        print("|--------------:|--------------:|----------------:|------------:|--------------:|--------:|")
+        for d, dev, host, _ in points:
+            print(
+                f"| {d:,} | {dev['rows_per_sec']/1e6:.1f}M | "
+                f"{dev['peak_rss_gb']:.2f}GB | {host['rows_per_sec']/1e6:.2f}M | "
+                f"{host['peak_rss_gb']:.2f}GB | "
+                f"{dev['rows_per_sec']/host['rows_per_sec']:.1f}x |"
+            )
+    else:
+        print(json.dumps({
+            "rows": rows,
+            "points": [
+                {"distinct": d, "device": dev, "host": host, "bit_exact": exact}
+                for d, dev, host, exact in points
+            ],
+        }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=25_000_000)
+    ap.add_argument("--distinct", type=str, default="100,10000,1000000,3571428,5000000")
+    ap.add_argument("--engine", choices=["device", "host"], default="device")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--point", action="store_true", help="one in-process point (internal)")
+    ap.add_argument("--markdown", action="store_true", help="emit the PERF.md table")
+    args = ap.parse_args()
+    if args.point:
+        distinct = int(args.distinct.split(",")[0])
+        print(json.dumps(measure_point(args.rows, distinct, args.engine, args.seed)), flush=True)
+        return
+    sweep(args.rows, [int(d) for d in args.distinct.split(",")], args.markdown, args.seed)
+
+
+if __name__ == "__main__":
+    main()
