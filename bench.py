@@ -573,6 +573,62 @@ def run_device_resident_stage(
     }
 
 
+def run_mesh_scaling_stage(rows: int = 2_000_000) -> dict:
+    """ROADMAP item 2's acceptance artifact: 1→2→4→8-device sharded-scan
+    throughput plus a chaos point that kills one shard mid-stage and
+    records the recovery wall-time (salvage + re-shard + replay vs the
+    clean run at the same mesh size). Runs in a DETACHED subprocess so the
+    stage can force a multi-device platform (8 virtual CPU devices when no
+    accelerator mesh exists) without re-configuring this process's jax.
+    On CPU the absolute points model nothing (virtual devices share the
+    same cores) — what transfers is the SHAPE and the measured recovery
+    cost; a TPU host runs the same stage over its real mesh."""
+    import json as _json
+    import os
+    import subprocess
+
+    import jax
+
+    env = dict(os.environ)
+    if jax.default_backend() == "cpu":
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mesh_scaling_bench", "--stage-json",
+         str(rows)],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=subprocess_timeout_s(),
+        env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh_scaling subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}"
+        )
+    result = _json.loads(proc.stdout.strip().splitlines()[-1])
+    result["stage_seconds"] = time.perf_counter() - t0
+    chaos = result.get("chaos") or {}
+    log(
+        "[mesh_scaling] points "
+        + " ".join(
+            f"{k}dev {v / 1e6:.2f}M rows/s"
+            for k, v in sorted(result["points"].items(), key=lambda kv: int(kv[0]))
+        )
+        + (
+            f"; chaos recovery {chaos['recovery_s']:.2f}s "
+            f"(losses {chaos['shard_losses']}, reshards "
+            f"{chaos['mesh_reshards']}, parity "
+            f"{'ok' if chaos['parity_ok'] else 'MISMATCH'})"
+            if chaos else "; chaos drill skipped (single device)"
+        )
+    )
+    return result
+
+
 def run_xla_prewarm_stage() -> dict:
     """Pre-warm the persistent XLA compilation cache from a DETACHED
     staging process (ROADMAP item 1): a subprocess runs the 1-batch
@@ -1310,6 +1366,23 @@ def main() -> None:
         out["spill_rows_per_sec"] = round(spill["rows_per_sec"], 1)
         out["spill_peak_rss_gb"] = spill["peak_rss_gb"]
         checkpoint("spill", extra={"peak_rss_gb": spill["peak_rss_gb"]})
+
+    mesh_scaling = staged(
+        "mesh_scaling", run_mesh_scaling_stage,
+        min(2_000_000, max(scan_rows // 25, 400_000)),
+    )
+    if mesh_scaling is not None:
+        out["mesh_scaling_rows_per_sec"] = {
+            k: round(v, 1) for k, v in mesh_scaling["points"].items()
+        }
+        chaos = mesh_scaling.get("chaos") or {}
+        if chaos:
+            out["mesh_recovery_s"] = chaos["recovery_s"]
+            out["mesh_chaos_parity_ok"] = chaos["parity_ok"]
+        checkpoint("mesh_scaling", extra={
+            "points": {k: round(v, 1) for k, v in mesh_scaling["points"].items()},
+            **({"chaos": chaos} if chaos else {}),
+        })
 
     suggest = staged(
         "suggest", run_suggestion_stage, max(profile_rows // 20, 100_000)

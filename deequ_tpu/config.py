@@ -119,6 +119,22 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 
 
 # ---------------------------------------------------------------------------
+# Elastic mesh fault tolerance (implemented in deequ_tpu.parallel.elastic /
+# .health; the env knobs are documented here with the other operator-facing
+# switches and re-exported below). Both follow the warn-and-fallback
+# convention: an unparseable value warns once and keeps the default.
+#
+# - DEEQU_TPU_MESH_LADDER: comma-separated descending device counts the
+#   re-shard ladder walks after a shard loss (default "8,4,2,1"). When no
+#   rung fits the survivors, the fold drops to the host tier with the
+#   salvaged canonical states — folded work is never lost.
+# - DEEQU_TPU_SHARD_HEARTBEAT_S: seconds between heartbeat probes of a live
+#   mesh fold, and each probe's per-shard deadline (default 5.0; <= 0
+#   disables the periodic heartbeat). A shard missing its heartbeat is
+#   declared lost exactly like a thrown ShardLossError.
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Tracing / flight recorder (implemented in deequ_tpu.observability; the env
 # knobs are documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -139,6 +155,8 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 #   artifacts dumped on typed failures (DeviceFailure / ScanStallError /
 #   CorruptStateError / SchemaDriftError). Unset = per-process temp dir.
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
+from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401
+from .parallel.health import HEARTBEAT_ENV as SHARD_HEARTBEAT_ENV  # noqa: E402,F401
 from .observability.trace import TRACE_ENV, TRACE_RING_ENV  # noqa: E402,F401
 from .analyzers.grouping import (  # noqa: E402,F401
     DEVICE_FREQ_ENV,

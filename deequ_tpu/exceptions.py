@@ -115,6 +115,40 @@ class SchemaDriftError(MetricCalculationRuntimeException):
         )
 
 
+class ShardLossError(DeviceFailureException):
+    """A shard of a multi-device mesh was lost mid-pass: a dead device, a
+    dead ``jax.distributed`` process, or a heartbeat-declared stall. Unlike
+    a plain :class:`DeviceFailureException` (one sick accelerator, recover
+    on the host), a shard loss is MESH-recoverable: the surviving shards'
+    algebraic states are mergeable by construction, so the elastic layer
+    (`deequ_tpu.parallel.elastic`) salvages them, rebuilds the mesh over
+    the surviving devices one ladder rung down, and resumes the fold —
+    ``classify_failure`` maps this class to ``"mesh"`` so an escaped loss
+    re-shards BEFORE the host-tier failover applies.
+
+    ``lost`` holds the mesh positions (indices into ``mesh.devices.flat``)
+    declared dead; ``survivors`` optionally carries the surviving device
+    objects so a pass-level retry can rebuild a mesh without re-probing."""
+
+    def __init__(self, lost, site: str = "", survivors=None, detail: str = ""):
+        self.lost = tuple(int(i) for i in lost)
+        self.site = site
+        self.survivors = None if survivors is None else list(survivors)
+        super().__init__(
+            f"mesh shard loss at {site or '<mesh>'}: shard(s) "
+            f"{list(self.lost)} lost"
+            + (f": {detail}" if detail else "")
+        )
+
+
+class ShardStallError(ShardLossError):
+    """A shard stopped making progress (heartbeat probe exceeded
+    ``DEEQU_TPU_SHARD_HEARTBEAT_S``) without raising. Declared lost after
+    the probe deadline — the hang-not-crash failure mode on a mesh, handled
+    exactly like a thrown shard loss (salvage + re-shard), mirroring how
+    :class:`ScanStallError` piggybacks on the device-failover path."""
+
+
 class ScanStallError(DeviceFailureException):
     """A device or host-tier pass exceeded its watchdog deadline without
     finishing OR failing — the hang-not-crash failure mode the exception-

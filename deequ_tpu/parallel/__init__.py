@@ -389,3 +389,24 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
     return tuple(
         jax.tree_util.tree_map(lambda x: x[0], tree) for tree in merged
     )
+
+
+# elastic fault tolerance rides on the primitives above; imported LAST so
+# the submodules can `from . import sharded_ingest_fold` etc. without a
+# cycle (PEP 328 partial-module semantics: the names are already bound)
+from .elastic import (  # noqa: E402,F401
+    ElasticMeshFold,
+    MESH_LADDER_ENV,
+    host_merge_states,
+    mesh_batch_quantum,
+    mesh_ladder,
+    next_rung,
+    salvage_stacked_states,
+    stack_canonical_states,
+)
+from .health import (  # noqa: E402,F401
+    HEARTBEAT_ENV,
+    HeartbeatGate,
+    probe_shards,
+    shard_heartbeat_s,
+)

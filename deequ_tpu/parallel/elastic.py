@@ -1,0 +1,449 @@
+"""Elastic degraded-mesh execution: a sharded fold that survives shard loss.
+
+The multi-chip scan (`sharded_ingest_fold` + `collective_merge_states`)
+folds PER-DEVICE algebraic states: each shard's state is a semigroup value
+covering exactly the batch partials that device folded. Before this
+module, one dead device, dead DCN process or stalled shard aborted the
+whole pass and threw away every SURVIVING shard's folded state — the exact
+failure the state algebra makes unnecessary, because per-shard states are
+mergeable by construction. This module closes that gap:
+
+1. **Detection**: a fold dispatch raising :class:`ShardLossError` (real
+   collective failure, injected ``mesh_loss``/``shard_stall`` fault, or a
+   heartbeat probe declaring a shard dead — `parallel/health.py`) names the
+   lost mesh positions.
+2. **Salvage**: the surviving shards' states are fetched host-side and
+   merged into ONE canonical state per analyzer (`host_merge_states` —
+   device-free, so it works while the mesh is broken).
+3. **Re-shard**: the mesh is rebuilt over the surviving devices at the
+   next rung of the ladder (``DEEQU_TPU_MESH_LADDER``, default 8→4→2→1),
+   the canonical merge becomes shard 0's state and the fold resumes. When
+   the ladder is exhausted the fold drops to **host mode** — the canonical
+   states keep folding eagerly on the host, the last-resort tier — so
+   folded state is never lost even when no mesh can be rebuilt.
+4. **Replay**: every fold records which global batch indices each shard
+   owns; a lost shard's batches are exactly recomputable, and the engine
+   replays them (and only them) on the rebuilt mesh, restoring the final
+   merge to cover every batch exactly once.
+
+Checkpoints compose: the engine checkpoints the CANONICAL merged states
+(:meth:`ElasticMeshFold.canonical`), which are mesh-shape independent — a
+checkpoint taken on 8 devices resumes on 4 (or on the host) bit-for-bit at
+the state level, because the canonical form never mentions the mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time
+from typing import Any, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+#: env var: comma-separated descending device counts the re-shard ladder
+#: walks after a loss (e.g. "8,4,2,1"). Follows the warn-and-fallback
+#: convention: unparseable values warn once and keep the default.
+MESH_LADDER_ENV = "DEEQU_TPU_MESH_LADDER"
+DEFAULT_MESH_LADDER = (8, 4, 2, 1)
+
+_ENV_WARNED = False
+
+
+def mesh_ladder() -> Tuple[int, ...]:
+    """The configured re-shard ladder, descending."""
+    raw = os.environ.get(MESH_LADDER_ENV)
+    if raw is None:
+        return DEFAULT_MESH_LADDER
+    try:
+        rungs = tuple(
+            sorted({int(p) for p in raw.split(",") if p.strip()}, reverse=True)
+        )
+        if not rungs or any(r < 1 for r in rungs):
+            raise ValueError(raw)
+    except ValueError:
+        global _ENV_WARNED
+        if not _ENV_WARNED:
+            _ENV_WARNED = True
+            _logger.warning(
+                "ignoring unparseable %s=%r (expected comma-separated "
+                "positive device counts); keeping the default ladder %s",
+                MESH_LADDER_ENV, raw, DEFAULT_MESH_LADDER,
+            )
+        return DEFAULT_MESH_LADDER
+    return rungs
+
+
+def next_rung(ladder: Sequence[int], survivors: int) -> Optional[int]:
+    """The largest ladder rung a mesh of ``survivors`` devices can fill,
+    or None (ladder exhausted -> host mode)."""
+    fitting = [r for r in ladder if r <= survivors]
+    return max(fitting) if fitting else None
+
+
+def mesh_batch_quantum(n_dev: int, ladder: Optional[Sequence[int]] = None) -> int:
+    """The multiple mesh batch sizes round to. Shape-INDEPENDENT across the
+    ladder: rounding to ``lcm(n_dev, max rung)`` gives every rung of the
+    (power-of-two) ladder the same effective batch size, which is what
+    makes a checkpoint taken under one mesh shape resumable under a
+    smaller one (the meta record pins ``batch_size``; batch boundaries
+    must not move when the mesh shrinks)."""
+    rungs = mesh_ladder() if ladder is None else tuple(ladder)
+    return math.lcm(max(1, int(n_dev)), max(rungs))
+
+
+def host_merge_states(analyzers: Sequence[Any], shard_states: List[Tuple]) -> Tuple:
+    """Merge per-shard states into one canonical state per analyzer with a
+    host-side eager left fold of each analyzer's semigroup ``merge`` — no
+    mesh, no collectives, so it works while the mesh is broken. Leaves
+    come back as numpy (host-resident: immune to further device loss).
+
+    ``shard_states``: list over shards of tuples (one state pytree per
+    analyzer). Empty list -> identity states."""
+    import jax
+
+    def to_host(tree):
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    if not shard_states:
+        return tuple(to_host(a.init_state()) for a in analyzers)
+    merged = []
+    for i, a in enumerate(analyzers):
+        acc = shard_states[0][i]
+        for shard in shard_states[1:]:
+            acc = a.merge(acc, shard[i])
+        merged.append(to_host(acc))
+    return tuple(merged)
+
+
+def stack_canonical_states(analyzers: Sequence[Any], canonical: Tuple, n_dev: int):
+    """Stack canonical merged states back into per-device form for a fresh
+    (possibly smaller) mesh: shard 0 carries the merge, shards 1..n-1 the
+    identity — algebraically the same total state, re-shardable."""
+    import jax
+    import jax.numpy as jnp
+
+    out = []
+    for a, state in zip(analyzers, canonical):
+        ident = a.init_state()
+
+        def stack_leaf(c, i):
+            c = jnp.asarray(c)
+            if n_dev == 1:
+                return c[None]
+            tile = jnp.broadcast_to(
+                jnp.asarray(i)[None], (n_dev - 1,) + jnp.asarray(i).shape
+            ).astype(c.dtype)
+            return jnp.concatenate([c[None], tile], axis=0)
+
+        out.append(jax.tree_util.tree_map(stack_leaf, state, ident))
+    return tuple(out)
+
+
+def salvage_stacked_states(
+    analyzers: Sequence[Any], stacked: Tuple, lost: Sequence[int]
+) -> Tuple[List[Tuple], List[int]]:
+    """Fetch the SURVIVING shards of stacked per-device states host-side.
+
+    Returns ``(shard_states, salvaged_positions)`` where ``shard_states``
+    is a list (one entry per salvaged shard, ascending position) of
+    per-analyzer state tuples. A shard whose fetch itself fails (its
+    buffers died with the device) is treated as lost too — salvage never
+    raises for a fetchable subset."""
+    import jax
+
+    lost_set = set(int(i) for i in lost)
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n_shards = int(leaves[0].shape[0]) if leaves else 0
+    shard_states: List[Tuple] = []
+    salvaged: List[int] = []
+    for pos in range(n_shards):
+        if pos in lost_set:
+            continue
+        try:
+            state = tuple(
+                jax.tree_util.tree_map(lambda x, _p=pos: np.asarray(x[_p]), tree)
+                for tree in stacked
+            )
+        except Exception as exc:  # noqa: BLE001 - a dead buffer = a lost shard
+            _logger.warning(
+                "shard %d unsalvageable (%s); treating it as lost", pos, exc
+            )
+            continue
+        shard_states.append(state)
+        salvaged.append(pos)
+    return shard_states, salvaged
+
+
+class MeshExhaustedError(RuntimeError):
+    """Internal: no ladder rung fits the survivors (callers drop to host
+    mode; this never escapes ElasticMeshFold)."""
+
+
+class ElasticMeshFold:
+    """A shard-loss-tolerant wrapper around ``sharded_ingest_fold``.
+
+    The engine feeds it stacked chunk partials exactly as it fed the raw
+    fold; the wrapper owns the per-device states, the batch-ownership
+    ledger, the heartbeat gate, and the salvage / re-shard / host-mode
+    recovery described in the module docstring. After the last chunk the
+    engine drains :meth:`take_lost_batches` (recomputing and re-folding
+    exactly those batches), then calls :meth:`finish` for the final
+    canonical merge.
+    """
+
+    def __init__(
+        self,
+        analyzers: Sequence[Any],
+        mesh,
+        monitor=None,
+        ladder: Optional[Sequence[int]] = None,
+        heartbeat_s: Optional[float] = None,
+    ):
+        from . import stack_identity_states
+        from .health import HeartbeatGate
+
+        self.analyzers = tuple(analyzers)
+        self.mesh = mesh
+        self.monitor = monitor
+        self.ladder = tuple(ladder) if ladder is not None else mesh_ladder()
+        self.host_mode = False
+        self.reshards = 0
+        n_dev = int(mesh.devices.size)
+        self.states = stack_identity_states(self.analyzers, n_dev)
+        #: per mesh position: the global batch indices folded into that
+        #: shard's state (what a loss of the shard would cost)
+        self._owned: List[Set[int]] = [set() for _ in range(n_dev)]
+        #: batches lost with dead shards, pending recompute+refold
+        self._lost_batches: Set[int] = set()
+        self._gate = HeartbeatGate(heartbeat_s)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def n_dev(self) -> int:
+        return 1 if self.host_mode else int(self.mesh.devices.size)
+
+    @property
+    def pending_replay(self) -> bool:
+        return bool(self._lost_batches)
+
+    def take_lost_batches(self) -> List[int]:
+        """Pop the batches lost with dead shards (the engine replays them)."""
+        todo = sorted(self._lost_batches)
+        self._lost_batches.clear()
+        return todo
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def seed(self, canonical: Tuple, folded_batches: int) -> None:
+        """Resume from checkpointed CANONICAL states covering batches
+        ``[0, folded_batches)``. The canonical merge becomes shard 0's
+        state; its batches enter the ledger so a later loss of shard 0
+        replays them instead of silently dropping the resumed history."""
+        if self.host_mode:
+            self.states = tuple(canonical)
+        else:
+            self.states = stack_canonical_states(
+                self.analyzers, tuple(canonical), self.n_dev
+            )
+        self._owned = [set() for _ in range(self.n_dev)]
+        self._owned[0] = set(range(int(folded_batches)))
+
+    def fold(self, stacked: Tuple, flags, batch_indices: Sequence[int]):
+        """Fold one chunk of stacked partials. ``batch_indices`` names the
+        global batch index behind each REAL slot (slot j real iff
+        ``flags[j]``; list length = number of real slots). Survives shard
+        loss internally: on loss the chunk retries on the rebuilt mesh (or
+        folds on the host when the ladder is out)."""
+        from . import sharded_ingest_fold
+
+        flags = np.asarray(flags, dtype=bool)
+        batch_indices = [int(i) for i in batch_indices]
+        while not self.host_mode:
+            if self._gate.due():
+                dead = self._gate.check(self.mesh)
+                if dead:
+                    from ..exceptions import ShardStallError
+
+                    self._recover(
+                        ShardStallError(dead, "heartbeat",
+                                        detail="shard heartbeat missed")
+                    )
+                    continue
+            try:
+                self.states = sharded_ingest_fold(
+                    self.analyzers, self.mesh, self.states, stacked, flags
+                )
+            except Exception as exc:  # noqa: BLE001 - classified below
+                from ..exceptions import ShardLossError
+                from ..reliability.isolation import classify_failure
+
+                if isinstance(exc, ShardLossError):
+                    self._recover(exc)
+                    continue
+                if classify_failure(exc) == "device" and self.n_dev > 1:
+                    # a raw collective/runtime error on a >1-device mesh:
+                    # probe WHO died rather than abandoning every survivor
+                    from .health import probe_shards
+
+                    dead = probe_shards(self.mesh)
+                    self._recover(
+                        ShardLossError(
+                            dead or [0], "sharded_fold",
+                            detail=f"{type(exc).__name__}: {exc}",
+                        )
+                    )
+                    continue
+                raise
+            self._record_ownership(flags, batch_indices)
+            return self.states
+        # host last resort: eager fold of the real slots, in batch order
+        self._host_fold(stacked, flags)
+        return self.states
+
+    def _record_ownership(self, flags, batch_indices: List[int]) -> None:
+        chunk = len(flags)
+        local = max(1, chunk // self.n_dev)
+        real = 0
+        for j in range(chunk):
+            if not flags[j]:
+                continue
+            self._owned[min(j // local, self.n_dev - 1)].add(
+                batch_indices[real]
+            )
+            real += 1
+
+    def _host_fold(self, stacked: Tuple, flags) -> None:
+        import jax
+
+        states = list(self.states)
+        for j in range(len(flags)):
+            if not flags[j]:
+                continue
+            for i, a in enumerate(self.analyzers):
+                partial = jax.tree_util.tree_map(
+                    lambda x, _j=j: x[_j], stacked[i]
+                )
+                states[i] = a.ingest_partial(states[i], partial)
+        self.states = tuple(
+            jax.tree_util.tree_map(np.asarray, s) for s in states
+        )
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self, exc) -> None:
+        """Salvage survivors, rebuild the mesh one rung down (or drop to
+        host mode), queue the lost shards' batches for replay."""
+        from ..observability import record_failure
+        from ..observability import trace as _trace
+        from . import make_mesh
+
+        lost = sorted(set(exc.lost)) or [0]
+        devices = list(self.mesh.devices.flat)
+        old_n = len(devices)
+        record_failure(exc)
+        _trace.add_event(
+            "shard_loss", site=getattr(exc, "site", ""), lost=lost,
+            mesh_devices=old_n,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+        if self.monitor is not None:
+            self.monitor.bump("shard_losses", len(lost))
+        _logger.warning(
+            "mesh shard loss (%d of %d shards: %s); salvaging surviving "
+            "states and re-sharding", len(lost), old_n, lost,
+        )
+        t0 = time.perf_counter()
+        shard_states, salvaged = salvage_stacked_states(
+            self.analyzers, self.states, lost
+        )
+        canonical = host_merge_states(self.analyzers, shard_states)
+        if self.monitor is not None:
+            self.monitor.bump("salvaged_states", len(salvaged))
+        # every batch a non-salvaged shard folded must be recomputed
+        salvaged_set = set(salvaged)
+        kept: Set[int] = set()
+        for pos, owned in enumerate(self._owned):
+            if pos in salvaged_set:
+                kept |= owned
+            else:
+                self._lost_batches |= owned
+        _trace.add_event(
+            "salvage", shards=len(salvaged),
+            batches_kept=len(kept), batches_lost=len(self._lost_batches),
+            seconds=round(time.perf_counter() - t0, 4),
+        )
+        survivors = [d for i, d in enumerate(devices) if i not in set(lost)]
+        rung = next_rung(self.ladder, len(survivors))
+        if rung is None:
+            self.host_mode = True
+            self.states = canonical
+            self._owned = [kept]
+            if self.monitor is not None:
+                self.monitor.bump("mesh_reshards")
+                self.monitor.note_degraded("mesh:host")
+            self.reshards += 1
+            _trace.add_event(
+                "mesh_reshard", from_devices=old_n, to_devices=0, tier="host",
+            )
+            _logger.warning(
+                "re-shard ladder exhausted (%d survivors, ladder %s); "
+                "continuing the fold on the host tier with the salvaged "
+                "canonical states", len(survivors), self.ladder,
+            )
+            return
+        self.mesh = make_mesh(devices=survivors[:rung])
+        self.states = stack_canonical_states(self.analyzers, canonical, rung)
+        self._owned = [set() for _ in range(rung)]
+        self._owned[0] = kept
+        self.reshards += 1
+        if self.monitor is not None:
+            self.monitor.bump("mesh_reshards")
+            self.monitor.note_degraded(f"mesh:{old_n}->{rung}")
+        _trace.add_event(
+            "mesh_reshard", from_devices=old_n, to_devices=rung, tier="mesh",
+        )
+        _logger.warning(
+            "mesh rebuilt over %d surviving devices (ladder %s); resuming "
+            "the fold from the salvaged merge", rung, self.ladder,
+        )
+
+    # -- termination ---------------------------------------------------------
+
+    def canonical(self) -> Tuple:
+        """The canonical merged states RIGHT NOW (for mesh-shape-independent
+        checkpoints) without consuming the per-device states. Mesh-path
+        merges that themselves hit a shard loss recover (salvage +
+        re-shard) and re-merge."""
+        if self.host_mode:
+            return self.states
+        from . import collective_merge_states
+
+        while not self.host_mode:
+            try:
+                return collective_merge_states(
+                    self.analyzers, self.mesh, self.states
+                )
+            except Exception as exc:  # noqa: BLE001 - classified below
+                from ..exceptions import ShardLossError
+
+                if isinstance(exc, ShardLossError):
+                    self._recover(exc)
+                    continue
+                raise
+        return self.states
+
+    def finish(self) -> Tuple:
+        """Final canonical merge. The engine must drain
+        :meth:`take_lost_batches` first — finishing with pending replays
+        would under-count exactly the lost shards' batches."""
+        if self.pending_replay:
+            raise RuntimeError(
+                "ElasticMeshFold.finish() called with lost batches pending "
+                "replay; drain take_lost_batches() first"
+            )
+        return self.canonical()

@@ -1,0 +1,142 @@
+"""Per-shard heartbeat/health probing for multi-device meshes.
+
+The reliability layer reacts to thrown faults, and the scan watchdog to a
+WHOLE pass hanging — but a sharded fold can also lose exactly ONE shard: a
+device drops off the ICI, a ``jax.distributed`` process dies, one chip
+wedges while its seven neighbours keep folding. From the caller's side
+that looks like either a raised collective error or a silent stall, and in
+both cases the question the elastic layer needs answered is *which shards
+are still alive*. This module answers it:
+
+- :func:`probe_shards` runs a trivial round-trip (``device_put`` +
+  ``block_until_ready``) against every device of a mesh, each under the
+  heartbeat deadline, and returns the mesh positions that failed or
+  stalled — the ground truth a salvage decision is made from;
+- :class:`HeartbeatGate` time-gates the probe (default every
+  ``DEEQU_TPU_SHARD_HEARTBEAT_S`` seconds) so the per-chunk fold path pays
+  one clock read, not a device round-trip, between heartbeats.
+
+Fault injection: each shard's probe passes through the ``shard_probe``
+fault site (tag = shard position), so tests can declare any shard dead
+deterministically (``mesh_loss``/``shard_stall`` kinds) without owning
+hardware that can actually lose a chip.
+
+``DEEQU_TPU_SHARD_HEARTBEAT_S`` follows the established warn-and-fallback
+convention: unparseable values warn once and keep the default; any value
+<= 0 disables the periodic heartbeat (explicit probes still work).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..exceptions import ShardLossError
+from ..reliability.faults import fault_point
+
+_logger = logging.getLogger(__name__)
+
+#: env var: seconds between heartbeat probes of a live mesh fold (also the
+#: per-shard probe deadline). <= 0 disables the periodic heartbeat.
+HEARTBEAT_ENV = "DEEQU_TPU_SHARD_HEARTBEAT_S"
+DEFAULT_HEARTBEAT_S = 5.0
+
+#: warn-once latch for an unparseable env override
+_ENV_WARNED = False
+
+
+def shard_heartbeat_s() -> Optional[float]:
+    """The configured heartbeat interval in seconds, or ``None`` when the
+    periodic heartbeat is disabled (value <= 0)."""
+    raw = os.environ.get(HEARTBEAT_ENV)
+    if raw is None:
+        return DEFAULT_HEARTBEAT_S
+    try:
+        value = float(raw)
+    except ValueError:
+        global _ENV_WARNED
+        if not _ENV_WARNED:
+            _ENV_WARNED = True
+            _logger.warning(
+                "ignoring unparseable %s=%r (expected seconds as a number); "
+                "keeping the default %.1fs heartbeat",
+                HEARTBEAT_ENV, raw, DEFAULT_HEARTBEAT_S,
+            )
+        return DEFAULT_HEARTBEAT_S
+    return value if value > 0 else None
+
+
+def probe_shards(mesh, deadline_s: Optional[float] = None) -> List[int]:
+    """Probe every device of ``mesh`` and return the DEAD mesh positions
+    (indices into ``mesh.devices.flat``): a probe that raises, or that
+    fails to complete within ``deadline_s`` (default: the heartbeat
+    interval), declares its shard lost.
+
+    Each probe is one scalar ``device_put`` + ``block_until_ready`` — the
+    cheapest op that still requires the device runtime to respond. Probes
+    run on a single daemon worker so a wedged device cannot hang the
+    caller; on timeout the worker is abandoned mid-probe and every
+    not-yet-confirmed shard is declared stalled (a wedged chip early in
+    the device order must not grant its neighbours a pass by starvation).
+    """
+    import numpy as np
+
+    import jax
+
+    if deadline_s is None:
+        deadline_s = shard_heartbeat_s() or DEFAULT_HEARTBEAT_S
+    devices = list(mesh.devices.flat)
+    dead: List[int] = []
+    confirmed = [False] * len(devices)
+
+    def probe_all() -> None:
+        for i, device in enumerate(devices):
+            try:
+                fault_point("shard_probe", tag=str(i))
+                jax.device_put(np.int32(1), device).block_until_ready()
+            except ShardLossError as exc:
+                # an injected loss names its shards; an empty list means
+                # "this position"
+                dead.extend(exc.lost or (i,))
+            except Exception:  # noqa: BLE001 - a raising probe IS the signal
+                dead.append(i)
+            confirmed[i] = True
+
+    worker = threading.Thread(
+        target=probe_all, name="deequ-shard-probe", daemon=True
+    )
+    worker.start()
+    worker.join(deadline_s)
+    if worker.is_alive():
+        # abandoned mid-probe: everything unconfirmed is stalled
+        dead.extend(i for i in range(len(devices)) if not confirmed[i])
+    if dead:
+        _logger.warning(
+            "shard heartbeat: %d/%d shards unresponsive (positions %s)",
+            len(set(dead)), len(devices), sorted(set(dead)),
+        )
+    return sorted({i for i in dead if 0 <= i < len(devices)})
+
+
+class HeartbeatGate:
+    """Time-gated heartbeat: ``due()`` is a clock read; when the interval
+    has elapsed, :meth:`check` probes the mesh and returns the dead
+    positions (empty list = healthy). Disabled heartbeat -> never due."""
+
+    def __init__(self, interval_s: Optional[float] = None):
+        self.interval_s = (
+            shard_heartbeat_s() if interval_s is None else interval_s
+        )
+        self._last = time.monotonic()
+
+    def due(self) -> bool:
+        if self.interval_s is None:
+            return False
+        return (time.monotonic() - self._last) >= self.interval_s
+
+    def check(self, mesh) -> List[int]:
+        self._last = time.monotonic()
+        return probe_shards(mesh, deadline_s=self.interval_s)

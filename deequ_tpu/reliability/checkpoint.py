@@ -19,6 +19,15 @@ the battery fingerprint must match, else the checkpoint is ignored and the
 run starts fresh (a checkpoint from a DIFFERENT run shape must never leak
 states into this one). Completion clears the meta so a finished run's
 checkpoint cannot resurrect into the next.
+
+Mesh-shape independence: the meta record deliberately pins NOTHING about
+the device mesh. Mesh runs checkpoint their states in CANONICAL (merged)
+form (`ElasticMeshFold.canonical`), and the engine rounds mesh batch
+sizes to the re-shard-ladder quantum (`parallel.mesh_batch_quantum`), so
+batch boundaries — and therefore this record's ``batch_size`` — are
+identical at every ladder rung. A checkpoint taken on 8 devices resumes
+on 4, on 1, or on the plain host tier (pinned by
+``tests/test_elastic_mesh.py::TestCrossShapeCheckpoint``).
 """
 
 from __future__ import annotations

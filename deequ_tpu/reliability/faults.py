@@ -36,12 +36,21 @@ site                 fires
 ``state_load``       in FileSystemStateProvider.load, tag = repr(analyzer)
 ``repository_load``  in the FS metrics repository's read-all, tag = path
 ``stream_fold``      before a streaming session's fold mutates state
+``shard_probe``      per mesh shard in the heartbeat health probe, tag = shard
 ===================  ========================================================
 
 The ``corrupt`` kind (a typed ``CorruptStateError``) injected at the three
 load sites stands in for bit rot/torn writes the checksum layer would
 detect; ``drift`` (a typed ``SchemaDriftError``) at ``stream_fold`` stands
 in for a micro-batch whose schema drifted from the session contract.
+
+The mesh kinds: ``mesh_loss`` (a typed ``ShardLossError`` whose ``lost``
+list carries the spec's ``shard``, default 0) stands in for a device or
+process dying mid-pass — injected at ``sharded_fold``/``collective_merge``
+it exercises the elastic salvage + re-shard path, at ``shard_probe`` it
+makes the heartbeat declare that shard dead; ``shard_stall`` (a typed
+``ShardStallError``, same payload) stands in for a shard that wedged
+without raising and was declared lost by the heartbeat deadline.
 """
 
 from __future__ import annotations
@@ -83,7 +92,9 @@ class WorkerCrash(RuntimeError):
 
 
 #: fault kind -> exception factory (tag-aware where the type carries one)
-def _make_error(kind: str, site: str, tag: str) -> BaseException:
+def _make_error(
+    kind: str, site: str, tag: str, shard: Optional[int] = None
+) -> BaseException:
     note = f"injected fault at site={site!r} tag={tag!r}"
     if kind == "device":
         return DeviceFailureException(note)
@@ -105,12 +116,20 @@ def _make_error(kind: str, site: str, tag: str) -> BaseException:
         return CorruptStateError("injected payload", site, note)
     if kind == "drift":
         return SchemaDriftError(site, [note])
+    if kind == "mesh_loss":
+        from ..exceptions import ShardLossError
+
+        return ShardLossError([0 if shard is None else shard], site, detail=note)
+    if kind == "shard_stall":
+        from ..exceptions import ShardStallError
+
+        return ShardStallError([0 if shard is None else shard], site, detail=note)
     raise ValueError(f"unknown fault kind {kind!r}")
 
 
 FAULT_KINDS = (
     "device", "oom", "poison", "analyzer", "interrupt", "worker_death",
-    "stall", "corrupt", "drift",
+    "stall", "corrupt", "drift", "mesh_loss", "shard_stall",
 )
 
 
@@ -121,7 +140,9 @@ class FaultSpec:
     tag), raise the ``kind`` error — at most ``count`` times (None =
     unlimited). ``kind="stall"`` sleeps ``delay_s`` instead of raising
     (compile-stall injection). Hit numbering is PER SITE and 1-based, so
-    ``at=2`` means "the second time this site fires"."""
+    ``at=2`` means "the second time this site fires". ``shard`` is the
+    mesh position the ``mesh_loss``/``shard_stall`` kinds declare lost
+    (default 0; meaningless for other kinds)."""
 
     site: str
     kind: str
@@ -131,6 +152,7 @@ class FaultSpec:
     count: Optional[int] = 1
     match: Optional[str] = None
     delay_s: float = 0.0
+    shard: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -204,7 +226,7 @@ class FaultInjector:
                 if spec.kind == "stall":
                     delay = spec.delay_s
                 else:
-                    error = _make_error(spec.kind, site, tag)
+                    error = _make_error(spec.kind, site, tag, shard=spec.shard)
                 break
         if delay:
             time.sleep(delay)

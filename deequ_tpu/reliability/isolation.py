@@ -49,7 +49,8 @@ _MAX_OOM_BISECTIONS = 3
 
 
 def classify_failure(exc: BaseException) -> str:
-    """``"oom"`` | ``"device"`` | ``"data"`` — what recovery applies.
+    """``"mesh"`` | ``"oom"`` | ``"device"`` | ``"data"`` — what recovery
+    applies.
 
     Typed exceptions from our own taxonomy classify directly; raw
     jax/jaxlib runtime errors (which carry no type hierarchy worth
@@ -65,15 +66,23 @@ def classify_failure(exc: BaseException) -> str:
     payload reproduces identically on any tier, so the recovery is
     degradation (typed Failure metrics for exactly the analyzers that
     needed it) or the loader-level quarantine/fresh-fold fallbacks, never
-    a pointless re-run elsewhere."""
+    a pointless re-run elsewhere. :class:`ShardLossError` classifies
+    ``"mesh"`` — one shard of a multi-device mesh died, which is
+    MESH-recoverable (rebuild over the survivors, one ladder rung down)
+    BEFORE the blunt host-tier failover applies; losses the engine's
+    in-pass elastic layer could not absorb surface here and re-shard at
+    the pass level."""
     from ..exceptions import (
         CorruptStateError,
         DeviceFailureException,
         DeviceOOMException,
+        ShardLossError,
     )
 
     if isinstance(exc, CorruptStateError):
         return "data"
+    if isinstance(exc, ShardLossError):
+        return "mesh"
     if isinstance(exc, DeviceOOMException):
         return "oom"
     if isinstance(exc, DeviceFailureException):
@@ -140,6 +149,7 @@ def run_scan_resilient(
     *,
     batch_size: int,
     placement: Optional[str],
+    sharding: Optional[Any] = None,
 ) -> ResilientScanOutcome:
     """Run the shared pass with isolation + failover.
 
@@ -147,7 +157,11 @@ def run_scan_resilient(
     -> (states, host_states)`` executes one engine pass (the runner owns
     engine construction); ``make_host_states() -> (states, update_fns)``
     builds FRESH host accumulators — retries must never refold into
-    partially-updated state.
+    partially-updated state. ``sharding`` (the pass's mesh, if any) lets
+    the tier ladder rebuild a DEGRADED mesh when a shard loss escapes the
+    engine's in-pass recovery: a mesh-sharded ``run_pass`` must then also
+    accept a ``sharding=`` keyword override (only ever passed after a
+    mesh failure, so mesh-free callers keep their simpler signature).
     """
     outcome = ResilientScanOutcome()
     host_keys = list(make_host_states()[0])
@@ -167,6 +181,7 @@ def run_scan_resilient(
         states, folded = _attempt_tiered(
             run_pass, part, host_states, host_updates,
             monitor, batch_size=batch_size, placement=placement,
+            sharding=sharding,
         )
         return states, folded
 
@@ -272,24 +287,58 @@ def _attempt_tiered(
     *,
     batch_size: int,
     placement: Optional[str],
+    sharding: Optional[Any] = None,
 ):
-    """One partition through the tier ladder: device (as placed) with OOM
-    batch bisection, then host-tier failover for device-infrastructure
-    failures when every member supports host partials."""
+    """One partition through the tier ladder: mesh re-shard for escaped
+    shard losses, then device (as placed) with OOM batch bisection, then
+    host-tier failover for device-infrastructure failures when every
+    member supports host partials."""
     bs = batch_size
     placement_now = placement
     oom_left = _MAX_OOM_BISECTIONS
+    mesh_now = sharding
+    mesh_overridden = False
     host_capable = bool(part) and all(
         getattr(a, "supports_host_partial", False) for a in part
     )
     while True:
         try:
-            return run_pass(
-                part, dict(host_states), host_updates,
-                placement=placement_now, batch_size=bs,
-            )
+            kwargs = {"placement": placement_now, "batch_size": bs}
+            if mesh_overridden:
+                kwargs["sharding"] = mesh_now
+            return run_pass(part, dict(host_states), host_updates, **kwargs)
         except Exception as exc:  # noqa: BLE001 - ladder decides
             kind = classify_failure(exc)
+            if kind == "mesh":
+                smaller = _degraded_mesh(mesh_now, exc)
+                if smaller is not None:
+                    # re-shard BEFORE host failover: the pass re-runs whole
+                    # on a mesh rebuilt over the surviving devices — the
+                    # mesh analog of the device->host hop, one rung at a
+                    # time (8->4->2->1), host only when the ladder is out
+                    monitor.bump("mesh_reshards")
+                    monitor.note_degraded("mesh:pass_reshard")
+                    record_failure(exc)
+                    _trace.add_event(
+                        "mesh_reshard",
+                        from_devices=int(mesh_now.devices.size),
+                        to_devices=int(smaller.devices.size),
+                        scope="pass",
+                    )
+                    _logger.warning(
+                        "mesh pass failed with a shard loss (%s); re-running "
+                        "the whole pass on a %d-device degraded mesh",
+                        exc, int(smaller.devices.size),
+                    )
+                    mesh_now = smaller
+                    mesh_overridden = True
+                    host_states = _refresh_host_states(host_states, monitor)
+                    continue
+                # no smaller mesh possible: drop the (broken) mesh and
+                # treat like a thrown device fault (tier failover below)
+                mesh_now = None
+                mesh_overridden = True
+                kind = "device"
             if kind == "oom" and oom_left > 0 and _oom_bisection_futile(part, bs):
                 # halving the batch shrinks the live FEATURE buffers but
                 # never a device frequency table's fixed-shape
@@ -360,6 +409,30 @@ def _oom_bisection_futile(part: Tuple, batch_size: int) -> bool:
     )
     reclaimable = 8 * batch_size * max(1, len(part))
     return table_bytes > reclaimable
+
+
+def _degraded_mesh(mesh, exc):
+    """A mesh rebuilt over ``exc``'s surviving devices at the next ladder
+    rung STRICTLY below the current size, or None when no smaller mesh is
+    possible (single device, no rung fits, no mesh to begin with)."""
+    if mesh is None:
+        return None
+    from ..parallel import make_mesh
+    from ..parallel.elastic import mesh_ladder, next_rung
+
+    devices = list(mesh.devices.flat)
+    survivors = getattr(exc, "survivors", None)
+    if survivors is None:
+        lost = set(getattr(exc, "lost", ()) or (0,))
+        survivors = [d for i, d in enumerate(devices) if i not in lost]
+    if not survivors:
+        return None
+    rung = next_rung(
+        [r for r in mesh_ladder() if r < len(devices)], len(survivors)
+    )
+    if rung is None:
+        return None
+    return make_mesh(devices=survivors[:rung])
 
 
 def _refresh_host_states(host_states: Dict[Any, Any], monitor) -> Dict[Any, Any]:

@@ -22,6 +22,14 @@ hang-detection analog of a thrown fault:
   watchdog-flagged job is requeued instead of failing outright
   (`scheduler._maybe_retry`).
 
+Division of labor with the mesh heartbeat: this watchdog guards the WHOLE
+pass (one deadline around the fold); `parallel/health.py`'s per-shard
+heartbeat guards individual mesh shards DURING the fold, declaring a
+wedged shard lost (typed ``ShardStallError``, a ``ShardLossError``) so
+the elastic layer salvages and re-shards instead of abandoning the whole
+pass — the pass-level deadline stays as the backstop when the entire mesh
+(or the host tier) hangs.
+
 Cancellation semantics: Python cannot kill a thread, so the stalled pass
 is ABANDONED on a daemon thread while the caller proceeds with recovery.
 The zombie's side effects are bounded by design — engine passes fold into

@@ -271,8 +271,10 @@ class AnalysisRunner:
         ):
             batch_rows = _ebs(data, batch_size)
             if sharding is not None:
-                n_dev = int(sharding.devices.size)
-                batch_rows = ((batch_rows + n_dev - 1) // n_dev) * n_dev
+                from ..parallel import mesh_batch_quantum
+
+                q = mesh_batch_quantum(int(sharding.devices.size))
+                batch_rows = ((batch_rows + q - 1) // q) * q
             for cols, members in grouping_sets.items():
                 if cols in device_freq:
                     continue
@@ -335,9 +337,21 @@ class AnalysisRunner:
             # metric-bearing leaves back over the feed link
             # (engine._fetch_states_packed's analyzers arg)
 
-            def run_pass(part, hs, hu, *, placement=None, batch_size=None):
+            outer_sharding = sharding
+            _KEEP_SHARDING = object()
+
+            def run_pass(
+                part, hs, hu, *, placement=None, batch_size=None,
+                sharding=_KEEP_SHARDING,
+            ):
+                # the reliability ladder overrides ``sharding`` only after
+                # a shard loss escaped the engine: the pass then re-runs
+                # whole on a mesh rebuilt over the surviving devices
+                pass_sharding = (
+                    outer_sharding if sharding is _KEEP_SHARDING else sharding
+                )
                 engine = ScanEngine(
-                    list(part), monitor=run_monitor, sharding=sharding,
+                    list(part), monitor=run_monitor, sharding=pass_sharding,
                     placement=placement,
                 )
                 g_sets = [
@@ -358,7 +372,7 @@ class AnalysisRunner:
             outcome = run_scan_resilient(
                 run_pass, full_battery, make_host_states, run_monitor,
                 batch_size=effective_batch_size(data, batch_size),
-                placement=placement,
+                placement=placement, sharding=sharding,
             )
 
             # drain the device frequency tables. A set whose table
@@ -409,7 +423,7 @@ class AnalysisRunner:
                 fb = run_scan_resilient(
                     run_pass, (), make_fallback_states, run_monitor,
                     batch_size=effective_batch_size(data, batch_size),
-                    placement=placement,
+                    placement=placement, sharding=sharding,
                 )
                 fallback_states = fb.host_states
                 fallback_errors = fb.host_errors

@@ -104,6 +104,15 @@ class RunMonitor:
     #: device frequency tables whose compactions dropped groups — those
     #: sets re-ran through the host accumulator last-resort tier
     freq_overflow_fallbacks: int = 0
+    #: mesh shards (devices/processes) declared lost mid-pass — dead
+    #: collectives, injected mesh_loss faults, heartbeat-declared stalls
+    shard_losses: int = 0
+    #: times a degraded mesh was rebuilt over the surviving devices (the
+    #: 8→4→2→1→host ladder; the terminal host drop counts too)
+    mesh_reshards: int = 0
+    #: surviving per-shard states salvaged into a canonical merge after a
+    #: shard loss (what the elastic layer kept instead of recomputing)
+    salvaged_states: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -128,6 +137,9 @@ class RunMonitor:
         self.cost_probes = 0
         self.device_freq_sets = 0
         self.freq_overflow_fallbacks = 0
+        self.shard_losses = 0
+        self.mesh_reshards = 0
+        self.salvaged_states = 0
 
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
@@ -1811,9 +1823,19 @@ class ScanEngine:
         monitor = self.monitor
         monitor.bump("passes")
         bs = effective_batch_size(data, batch_size)
-        if self.mesh is not None:
-            n_dev = self.mesh.devices.size
-            bs = ((bs + n_dev - 1) // n_dev) * n_dev  # shardable batches
+        if self.mesh is not None or checkpointer is not None:
+            from ..parallel import mesh_batch_quantum
+
+            # round to the LADDER quantum, not the mesh size: a checkpoint
+            # pins batch_size, so batch boundaries must stay put when the
+            # elastic layer rebuilds the mesh one rung smaller (8->4->2->1
+            # all see the same effective batch size). Checkpointed
+            # MESH-FREE runs round too — the documented mesh<->plain-host
+            # resume legs need both sides to derive the same boundaries
+            # from the same nominal batch size
+            n_dev = 1 if self.mesh is None else int(self.mesh.devices.size)
+            q = mesh_batch_quantum(n_dev)
+            bs = ((bs + q - 1) // q) * q  # shardable batches
         host_states = dict(host_accumulators or {})
         update_fns = host_update_fns or {}
         has_battery = bool(self.scan_analyzers)
@@ -1823,13 +1845,10 @@ class ScanEngine:
             # one probe per analyzer per pass: the injection point through
             # which tests pin "exactly the faulty analyzer degrades"
             fault_point("analyzer", tag=repr(a))
+        # mesh runs checkpoint in CANONICAL (merged) form, so the meta is
+        # mesh-shape independent: a checkpoint taken on 8 devices resumes
+        # on 4 (the batch-size quantum above keeps batch boundaries put)
         ckpt = checkpointer
-        if ckpt is not None and self.mesh is not None:
-            _logger.warning(
-                "ingest checkpointing is not supported on a mesh; "
-                "running without checkpoints"
-            )
-            ckpt = None
         resume = None
         ckpt_epoch = None
         if ckpt is not None:
@@ -2023,17 +2042,22 @@ class ScanEngine:
         monitor = self.monitor
         analyzers = tuple(self.scan_analyzers)
         mesh = self.mesh
+        elastic = None
         if mesh is not None:
             # mesh x host tier: per-device states, each fold shards the
             # chunk's partials over the devices; a final collective merge
             # combines the per-device states. The global chunk size stays
             # ~_INGEST_CHUNK so the padding waste is mesh-independent.
-            from ..parallel import sharded_ingest_fold, stack_identity_states
+            # The ElasticMeshFold owns the states: a shard lost mid-pass is
+            # salvaged (surviving states merge), the mesh rebuilds one
+            # ladder rung down and the lost shard's batches replay below.
+            from ..parallel import ElasticMeshFold
 
             n_dev = int(mesh.devices.size)
             local_chunk = max(1, _INGEST_CHUNK // n_dev)
             chunk = local_chunk * n_dev
-            states = stack_identity_states(analyzers, n_dev)
+            elastic = ElasticMeshFold(analyzers, mesh, monitor=monitor)
+            states = elastic.states
             program = None
         else:
             chunk = _INGEST_CHUNK
@@ -2060,12 +2084,20 @@ class ScanEngine:
             states = tuple(states_list)
         start_batch = 0
         host_start = 0
-        if resume is not None and mesh is None:
+        if resume is not None:
             start_batch = resume.batch_index
             # accumulators fold per SUBMITTED batch (ahead of the chunked
             # scan states), so they resume from their own high-water mark
             host_start = resume.host_batch_index
-            states = tuple(resume.scan_states)
+            if elastic is not None:
+                # checkpoints store CANONICAL merged states: seeding them
+                # into shard 0 of whatever mesh THIS run has is what makes
+                # a checkpoint taken under one mesh shape resume under a
+                # smaller one
+                elastic.seed(tuple(resume.scan_states), start_batch)
+                states = elastic.states
+            else:
+                states = tuple(resume.scan_states)
 
         # one token per pass: host partials may skip work a previous batch
         # of the SAME pass already contributed (e.g. HLL registers of
@@ -2092,24 +2124,29 @@ class ScanEngine:
                     )
                     return tuple(a.host_partial(ctx) for a in analyzers)
 
+        def stack_group(group: List[Tuple]) -> Tuple:
+            return tuple(
+                jax.tree_util.tree_map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                    *[p[i] for p in group],
+                )
+                for i in range(len(analyzers))
+            )
+
         def fold_chunk(states, group: List[Tuple], n_real: int):
             import time as _time
 
             fault_point("ingest_fold")
             with monitor.timed("ingest_fold"):
-                stacked = tuple(
-                    jax.tree_util.tree_map(
-                        lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                        *[p[i] for p in group],
-                    )
-                    for i in range(len(analyzers))
-                )
+                stacked = stack_group(group)
                 flags = np.zeros(len(group), dtype=bool)
                 flags[:n_real] = True
                 monitor.bump("device_updates")
-                if mesh is not None:
-                    return sharded_ingest_fold(
-                        analyzers, mesh, states, stacked, flags
+                if elastic is not None:
+                    first = progress["folded"]
+                    return elastic.fold(
+                        stacked, flags,
+                        batch_indices=range(first, first + n_real),
                     )
                 # per-bundle async dispatches; states reassemble in the
                 # original analyzer order. Pad slots (positions >= n_real
@@ -2156,14 +2193,30 @@ class ScanEngine:
         progress = {"folded": start_batch, "saved": start_batch}
 
         def maybe_checkpoint(states):
-            if checkpointer is None or mesh is not None:
+            if checkpointer is None:
                 return
             if progress["folded"] - progress["saved"] < checkpointer.every:
                 return
+            if elastic is not None and elastic.pending_replay:
+                # a shard loss left batches awaiting replay: the canonical
+                # merge does not cover them yet, so a checkpoint here would
+                # under-count exactly the lost shard's batches on resume
+                return
             with monitor.timed("checkpoint"):
+                if elastic is not None:
+                    # CANONICAL merged form: mesh-shape independent, so the
+                    # resume point works on any (smaller) mesh or the host
+                    ck_states = _fetch_states_packed(tuple(elastic.canonical()))
+                    if elastic.pending_replay:
+                        # a shard died DURING the canonical merge: the
+                        # snapshot under-counts its batches — skip this
+                        # save (the end-of-pass replay restores coverage)
+                        return
+                else:
+                    ck_states = _fetch_states_packed(tuple(states))
                 checkpointer.save(
                     progress["folded"], bs, int(data.num_rows),
-                    list(analyzers), _fetch_states_packed(tuple(states)),
+                    list(analyzers), ck_states,
                     host_states, host_batch_index=n, epoch=ckpt_epoch,
                 )
                 monitor.bump("checkpoint_saves")
@@ -2225,13 +2278,62 @@ class ScanEngine:
                     )
             except Exception:  # noqa: BLE001
                 pass
-        if mesh is not None:
-            # butterfly-merge the per-device states into one (the
-            # treeReduce analog, riding ICI)
-            from ..parallel import collective_merge_states
+        if elastic is not None:
+            # replay the batches lost with dead shards: recompute exactly
+            # those partials (same batch indices, so index-keyed analyzer
+            # logic replays identically) and fold them on whatever mesh
+            # survived. Loops because a shard can die during replay too.
+            def replay_pending():
+                todo = set(elastic.take_lost_batches())
+                _trace.add_event("mesh_replay", batches=len(todo))
+                _logger.warning(
+                    "replaying %d batches lost with dead mesh shards",
+                    len(todo),
+                )
+                replay_buf: List[Tuple] = []
+                replay_idx: List[int] = []
 
-            states = collective_merge_states(analyzers, mesh, states)
-        if checkpointer is not None and mesh is None:
+                def flush_replay(n_real: int):
+                    group = list(replay_buf)
+                    if n_real < chunk:
+                        ident = compute_partial(n, _empty_batch_like(data, columns))
+                        group.extend([ident] * (chunk - n_real))
+                    flags = np.zeros(chunk, dtype=bool)
+                    flags[:n_real] = True
+                    with monitor.timed("ingest_fold"):
+                        elastic.fold(
+                            stack_group(group), flags, batch_indices=replay_idx
+                        )
+                    replay_buf.clear()
+                    replay_idx.clear()
+
+                last_todo = max(todo)
+                for index, batch in enumerate(
+                    data.batches(bs, columns=columns, pad_to_batch_size=False)
+                ):
+                    if index > last_todo:
+                        break  # replay cost scales with len(todo), not rows
+                    if index not in todo:
+                        continue
+                    replay_buf.append(compute_partial(index, batch))
+                    replay_idx.append(index)
+                    if len(replay_buf) == chunk:
+                        flush_replay(chunk)
+                if replay_buf:
+                    flush_replay(len(replay_buf))
+
+            # butterfly-merge the per-device states into one (the
+            # treeReduce analog, riding ICI); on a broken mesh the merge
+            # itself recovers (salvage + re-shard, host merge last) — and
+            # a loss DURING the merge queues the dead shard's batches, so
+            # loop until a merge completes with nothing left to replay
+            while True:
+                while elastic.pending_replay:
+                    replay_pending()
+                states = elastic.finish()
+                if not elastic.pending_replay:
+                    break
+        if checkpointer is not None:
             checkpointer.complete(ckpt_epoch)
         with monitor.timed("state_fetch"):
             host_side = _fetch_states_packed(

@@ -199,6 +199,23 @@ class JobScheduler:
             "their deadline (hang-not-crash faults).",
         )
         self.metrics.describe(
+            "deequ_service_shard_losses_total",
+            "Mesh shards (devices/processes) declared lost mid-pass and "
+            "absorbed by the elastic layer (salvage + re-shard).",
+        )
+        self.metrics.describe(
+            "deequ_service_mesh_reshards_total",
+            "Degraded-mesh rebuilds after shard loss, walking the "
+            "8->4->2->1->host ladder (in-pass salvages and pass-level "
+            "re-runs both count).",
+        )
+        self.metrics.describe(
+            "deequ_service_salvaged_states_total",
+            "Surviving per-shard algebraic states salvaged into a "
+            "canonical merge after a shard loss (folded work kept, not "
+            "recomputed).",
+        )
+        self.metrics.describe(
             "deequ_service_analyzer_cost_seconds_total",
             "Per-analyzer cost attribution: each signature bundle's "
             "measured compile+dispatch seconds split across its slots, "
@@ -495,10 +512,31 @@ class JobScheduler:
                 "deequ_service_scan_stalls_total",
                 float(monitor.stalls), tenant=job.tenant,
             )
+        if monitor.shard_losses or monitor.mesh_reshards:
+            # mesh elasticity on the export plane: every shard loss, every
+            # re-shard (in-pass or pass-level) and every salvaged state is
+            # countable per tenant — the acceptance signal that a loss was
+            # absorbed rather than fatal
+            if monitor.shard_losses:
+                self.metrics.inc(
+                    "deequ_service_shard_losses_total",
+                    float(monitor.shard_losses), tenant=job.tenant,
+                )
+            if monitor.mesh_reshards:
+                self.metrics.inc(
+                    "deequ_service_mesh_reshards_total",
+                    float(monitor.mesh_reshards), tenant=job.tenant,
+                )
+            if monitor.salvaged_states:
+                self.metrics.inc(
+                    "deequ_service_salvaged_states_total",
+                    float(monitor.salvaged_states), tenant=job.tenant,
+                )
         if (
             monitor.device_failovers
             or monitor.batch_bisections
             or monitor.device_stalls
+            or monitor.shard_losses
         ):
             # the engine survived a device-tier fault under this battery:
             # teach the router to keep the battery on the host tier for a

@@ -1,0 +1,498 @@
+"""Elastic mesh fault tolerance (ISSUE 7): the multi-chip scan survives
+shard loss.
+
+The acceptance contract this file pins:
+
+- an injected loss of one device mid-pass completes the battery with
+  metrics equal to the uninterrupted run (salvage + re-shard + replay),
+  with the loss visible as ONE connected trace (shard_loss -> salvage ->
+  mesh_reshard -> completion) and counted on the export plane;
+- a second loss walks the ladder down, ultimately landing on the host
+  tier WITHOUT losing folded state;
+- a checkpoint taken under one mesh shape resumes under a smaller one
+  (8->4 and 4->1), equal to the uninterrupted run;
+- a shard loss on the GSPMD device path re-shards at the pass level
+  (classify_failure routes "mesh" to re-shard-before-host-failover);
+- the DEEQU_TPU_MESH_LADDER / DEEQU_TPU_SHARD_HEARTBEAT_S knobs follow
+  the warn-and-fallback convention.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.data import Dataset
+from deequ_tpu.exceptions import ShardLossError, ShardStallError
+from deequ_tpu.parallel import make_mesh
+from deequ_tpu.reliability import FaultSpec, inject
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import RunMonitor
+
+pytestmark = pytest.mark.mesh
+
+ROWS = 24_000
+BATCH = 512
+
+ANALYZERS = [
+    Size(),
+    Completeness("x"),
+    Mean("x"),
+    Sum("x"),
+    StandardDeviation("x"),
+    Maximum("x"),
+    ApproxCountDistinct("y"),
+    KLLSketch("x", KLLParameters(256, 0.64, 10)),
+]
+
+
+@pytest.fixture(scope="module")
+def data():
+    import pyarrow as pa
+
+    rng = np.random.default_rng(17)
+    x = rng.normal(5, 2, ROWS)
+    return Dataset.from_arrow(
+        pa.table(
+            {
+                "x": pa.array(x, mask=rng.random(ROWS) < 0.1),
+                "y": pa.array(rng.integers(0, 700, ROWS)),
+            }
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def clean(data):
+    """The uninterrupted-run oracle (mesh-free host tier)."""
+    return AnalysisRunner.do_analysis_run(
+        data, ANALYZERS, batch_size=BATCH, placement="host"
+    )
+
+
+def assert_parity(clean_ctx, lossy_ctx, rel=1e-9):
+    for a in ANALYZERS:
+        cv = clean_ctx.metric(a).value
+        lv = lossy_ctx.metric(a).value
+        assert cv.is_success and lv.is_success, a
+        if isinstance(a, KLLSketch):
+            ck = sum(b.count for b in cv.get().buckets)
+            lk = sum(b.count for b in lv.get().buckets)
+            assert ck == lk, a
+        else:
+            assert lv.get() == pytest.approx(cv.get(), rel=rel), a
+
+
+class TestShardLossRecovery:
+    def test_single_loss_salvage_reshard_parity(self, data, clean):
+        """One shard dies mid-fold: surviving states salvage, the mesh
+        rebuilds 8->4, the lost shard's batches replay, metrics match."""
+        mon = RunMonitor()
+        with inject(
+            FaultSpec("sharded_fold", "mesh_loss", at=2, shard=5)
+        ) as inj:
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                placement="host", monitor=mon,
+            )
+        assert inj.fired == ["sharded_fold::mesh_loss"]
+        assert mon.shard_losses == 1
+        assert mon.mesh_reshards == 1
+        assert mon.salvaged_states == 7
+        assert "mesh:8->4" in mon.degraded
+        assert_parity(clean, lossy)
+
+    def test_loss_during_collective_merge(self, data, clean):
+        """The final butterfly merge is a loss site too: the merge itself
+        recovers (salvage + re-shard + re-merge)."""
+        mon = RunMonitor()
+        with inject(
+            FaultSpec("collective_merge", "mesh_loss", at=1, shard=2)
+        ) as inj:
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                placement="host", monitor=mon,
+            )
+        assert inj.fired
+        assert mon.shard_losses == 1
+        assert mon.mesh_reshards == 1
+        assert_parity(clean, lossy)
+
+    def test_second_loss_walks_ladder_to_host(self, data, clean, monkeypatch):
+        """Two losses with a truncated ladder: 8->4, then 4 loses a shard
+        with no rung left -> the fold lands on the HOST tier with the
+        salvaged canonical states (folded work kept, run completes)."""
+        from deequ_tpu.parallel import elastic
+
+        monkeypatch.setenv(elastic.MESH_LADDER_ENV, "8,4")
+        mon = RunMonitor()
+        with inject(
+            FaultSpec("sharded_fold", "mesh_loss", at=1, shard=7),
+            FaultSpec("sharded_fold", "mesh_loss", at=2, shard=0),
+        ) as inj:
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                placement="host", monitor=mon,
+            )
+        assert len(inj.fired) == 2
+        assert mon.shard_losses == 2
+        assert mon.mesh_reshards == 2
+        assert "mesh:8->4" in mon.degraded
+        assert "mesh:host" in mon.degraded
+        assert_parity(clean, lossy)
+
+    def test_shard_stall_kind_recovers_like_loss(self, data, clean):
+        """shard_stall (heartbeat-declared wedge) takes the same salvage
+        path as a thrown loss — ShardStallError IS a ShardLossError."""
+        assert issubclass(ShardStallError, ShardLossError)
+        mon = RunMonitor()
+        with inject(
+            FaultSpec("sharded_fold", "shard_stall", at=2, shard=3)
+        ):
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                placement="host", monitor=mon,
+            )
+        assert mon.shard_losses == 1 and mon.mesh_reshards == 1
+        assert_parity(clean, lossy)
+
+    def test_pass_level_reshard_on_device_path(self, data, clean):
+        """A loss on the GSPMD device path (replicated states, no per-shard
+        salvage site) escapes the engine and re-shards at the PASS level:
+        classify_failure routes "mesh" to re-shard-before-host-failover."""
+        from deequ_tpu.reliability import classify_failure
+
+        assert classify_failure(ShardLossError([3], "x")) == "mesh"
+        mon = RunMonitor()
+        with inject(
+            FaultSpec("device_update", "mesh_loss", at=2, shard=3)
+        ) as inj:
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                monitor=mon,
+            )
+        assert inj.fired
+        assert mon.mesh_reshards == 1
+        assert "mesh:pass_reshard" in mon.degraded
+        # the re-run stayed on a (smaller) mesh, not the host tier
+        assert mon.device_failovers == 0
+        assert_parity(clean, lossy)
+
+
+class TestConnectedTrace:
+    def test_loss_is_one_connected_trace(self, data, clean):
+        """Acceptance: shard_loss -> salvage -> mesh_reshard -> completion
+        all ride ONE trace_id, with the typed failure event recorded."""
+        from deequ_tpu.observability.recorder import recorder
+
+        recorder().clear()
+        mon = RunMonitor()
+        with inject(FaultSpec("sharded_fold", "mesh_loss", at=2, shard=5)):
+            lossy = AnalysisRunner.do_analysis_run(
+                data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(8),
+                placement="host", monitor=mon,
+            )
+        assert_parity(clean, lossy)
+        spans = recorder().spans()
+        assert spans and len({s.trace_id for s in spans}) == 1
+        events = [ev["name"] for s in spans for ev in s.events]
+        for expected in ("shard_loss", "salvage", "mesh_reshard",
+                         "mesh_replay"):
+            assert expected in events, (expected, events)
+        failures = [
+            ev for s in spans for ev in s.events if ev["name"] == "failure"
+        ]
+        assert any(
+            ev["attrs"]["type"] == "ShardLossError" for ev in failures
+        )
+        passes = [s for s in spans if s.name == "engine_pass"]
+        assert passes and passes[-1].status == "ok"
+        recorder().clear()
+
+
+class TestExportPlane:
+    def test_mesh_counters_reach_prometheus(self, data):
+        """A service job absorbing a shard loss surfaces
+        deequ_service_{shard_losses,mesh_reshards,salvaged_states}_total."""
+        from deequ_tpu.checks import Check, CheckLevel
+        from deequ_tpu.service import VerificationService
+
+        check = (
+            Check(CheckLevel.ERROR, "mesh battery")
+            .has_size(lambda n: n == ROWS)
+            .has_mean("x", lambda m: 4 < m < 6)
+        )
+        with inject(FaultSpec("sharded_fold", "mesh_loss", at=2, shard=5)):
+            with VerificationService(
+                workers=1, mesh=make_mesh(8), background_warm=False,
+            ) as svc:
+                # a cold battery routes to the host tier, which on a mesh
+                # service IS the sharded elastic fold path
+                result = svc.verify(data, [check], timeout=300, batch_size=BATCH)
+                text = svc.prometheus_text()
+                counters = svc.json_snapshot()["counters"]
+        from deequ_tpu.checks import CheckStatus
+
+        assert result.status == CheckStatus.SUCCESS
+        assert "deequ_service_shard_losses_total" in text
+
+        def total(name: str) -> float:
+            out = 0.0
+            for k, v in counters.items():
+                if k.startswith(name):
+                    out += sum(v.values()) if isinstance(v, dict) else v
+            return out
+
+        assert total("deequ_service_shard_losses_total") >= 1
+        assert total("deequ_service_mesh_reshards_total") >= 1
+        assert total("deequ_service_salvaged_states_total") >= 1
+
+
+class TestCrossShapeCheckpoint:
+    @pytest.mark.parametrize("big,small", [(8, 4), (4, 1)])
+    def test_checkpoint_resumes_on_smaller_mesh(self, data, clean, big, small):
+        """A checkpoint taken under one mesh shape resumes under a smaller
+        one: states checkpoint in CANONICAL merged form and the batch-size
+        quantum keeps batch boundaries put across the ladder."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+        from deequ_tpu.reliability import IngestCheckpointer
+
+        ckpt = IngestCheckpointer(InMemoryStateProvider(), every=8)
+        with pytest.raises(KeyboardInterrupt):
+            with inject(FaultSpec("ingest_fold", "interrupt", at=2)):
+                AnalysisRunner.do_analysis_run(
+                    data, ANALYZERS, batch_size=BATCH,
+                    sharding=make_mesh(big), placement="host",
+                    checkpointer=ckpt,
+                )
+        assert ckpt.saves, "the interrupted run must have checkpointed"
+        mon = RunMonitor()
+        resumed = AnalysisRunner.do_analysis_run(
+            data, ANALYZERS, batch_size=BATCH, sharding=make_mesh(small),
+            placement="host", checkpointer=ckpt, monitor=mon,
+        )
+        assert mon.resumed_at_batch == ckpt.saves[-1][0]
+        assert mon.resumed_at_batch > 0
+        assert_parity(clean, resumed)
+
+    def test_mesh_and_plain_host_checkpoints_interchange(self, data, clean):
+        """The canonical form is tier-independent too: a mesh checkpoint
+        resumes on the PLAIN (mesh-free) host tier."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+        from deequ_tpu.reliability import IngestCheckpointer
+
+        ckpt = IngestCheckpointer(InMemoryStateProvider(), every=8)
+        with pytest.raises(KeyboardInterrupt):
+            with inject(FaultSpec("ingest_fold", "interrupt", at=2)):
+                AnalysisRunner.do_analysis_run(
+                    data, ANALYZERS, batch_size=BATCH,
+                    sharding=make_mesh(8), placement="host",
+                    checkpointer=ckpt,
+                )
+        mon = RunMonitor()
+        resumed = AnalysisRunner.do_analysis_run(
+            data, ANALYZERS, batch_size=BATCH, placement="host",
+            checkpointer=ckpt, monitor=mon,
+        )
+        assert mon.resumed_at_batch and mon.resumed_at_batch > 0
+        assert_parity(clean, resumed)
+
+    def test_non_quantum_batch_size_resumes_across_tiers(self, data, clean):
+        """A nominal batch size that is NOT a ladder-quantum multiple must
+        still resume mesh->plain-host: checkpointed runs round to the
+        quantum on BOTH sides, so the meta's batch_size matches."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+        from deequ_tpu.reliability import IngestCheckpointer
+
+        ckpt = IngestCheckpointer(InMemoryStateProvider(), every=8)
+        with pytest.raises(KeyboardInterrupt):
+            with inject(FaultSpec("ingest_fold", "interrupt", at=2)):
+                AnalysisRunner.do_analysis_run(
+                    data, ANALYZERS, batch_size=500,
+                    sharding=make_mesh(8), placement="host",
+                    checkpointer=ckpt,
+                )
+        mon = RunMonitor()
+        resumed = AnalysisRunner.do_analysis_run(
+            data, ANALYZERS, batch_size=500, placement="host",
+            checkpointer=ckpt, monitor=mon,
+        )
+        assert mon.resumed_at_batch and mon.resumed_at_batch > 0
+        assert_parity(clean, resumed)
+
+
+class TestHealthProbe:
+    def test_probe_reports_injected_dead_shard(self):
+        from deequ_tpu.parallel import probe_shards
+
+        mesh = make_mesh(4)
+        assert probe_shards(mesh) == []
+        with inject(
+            FaultSpec("shard_probe", "mesh_loss", at=3, shard=2)
+        ):
+            # at=3: the probe of position 2 (1-based hit numbering)
+            assert probe_shards(mesh) == [2]
+
+    def test_heartbeat_gate_is_time_gated(self):
+        from deequ_tpu.parallel.health import HeartbeatGate
+
+        gate = HeartbeatGate(interval_s=3600.0)
+        assert not gate.due()  # just constructed
+        gate._last -= 7200.0
+        assert gate.due()
+        assert gate.check(make_mesh(2)) == []
+        assert not gate.due()  # check() re-arms the timer
+
+    def test_disabled_heartbeat_never_due(self, monkeypatch):
+        from deequ_tpu.parallel import health
+
+        monkeypatch.setenv(health.HEARTBEAT_ENV, "0")
+        assert health.shard_heartbeat_s() is None
+        gate = health.HeartbeatGate()
+        gate._last -= 7200.0
+        assert not gate.due()
+
+
+class TestEnvKnobs:
+    def test_mesh_ladder_parses(self, monkeypatch):
+        from deequ_tpu.parallel import elastic
+
+        monkeypatch.setenv(elastic.MESH_LADDER_ENV, "4,2")
+        assert elastic.mesh_ladder() == (4, 2)
+
+    def test_mesh_ladder_warns_and_falls_back(self, monkeypatch, caplog):
+        import logging
+
+        from deequ_tpu.parallel import elastic
+
+        monkeypatch.setenv(elastic.MESH_LADDER_ENV, "eight,four")
+        monkeypatch.setattr(elastic, "_ENV_WARNED", False)
+        with caplog.at_level(logging.WARNING, logger=elastic.__name__):
+            assert elastic.mesh_ladder() == elastic.DEFAULT_MESH_LADDER
+        assert any("DEEQU_TPU_MESH_LADDER" in r.message for r in caplog.records)
+
+    def test_heartbeat_warns_and_falls_back(self, monkeypatch, caplog):
+        import logging
+
+        from deequ_tpu.parallel import health
+
+        monkeypatch.setenv(health.HEARTBEAT_ENV, "5s")
+        monkeypatch.setattr(health, "_ENV_WARNED", False)
+        with caplog.at_level(logging.WARNING, logger=health.__name__):
+            assert health.shard_heartbeat_s() == health.DEFAULT_HEARTBEAT_S
+        assert any(
+            "DEEQU_TPU_SHARD_HEARTBEAT_S" in r.message for r in caplog.records
+        )
+
+    def test_batch_quantum_is_ladder_shape_independent(self):
+        from deequ_tpu.parallel import mesh_batch_quantum
+
+        # every rung of the default ladder rounds to the same quantum, so
+        # batch boundaries (and checkpoint meta) survive a re-shard
+        assert len({mesh_batch_quantum(n) for n in (1, 2, 4, 8)}) == 1
+
+
+class TestElasticUnits:
+    def test_salvage_drops_exactly_the_lost_shards(self):
+        from deequ_tpu.parallel import salvage_stacked_states
+        from deequ_tpu.runners.engine import ScanEngine
+
+        analyzers = [Size(), Mean("x")]
+        per_shard = []
+        for d in range(4):
+            states, _ = ScanEngine(analyzers).run(
+                Dataset.from_dict({"x": np.full(10 * (d + 1), float(d))})
+            )
+            per_shard.append(states)
+        stacked = tuple(
+            jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[s[i] for s in per_shard],
+            )
+            for i in range(len(analyzers))
+        )
+        shard_states, salvaged = salvage_stacked_states(
+            analyzers, stacked, lost=[1]
+        )
+        assert salvaged == [0, 2, 3]
+        sizes = [int(np.asarray(s[0].num_matches)) for s in shard_states]
+        assert sizes == [10, 30, 40]
+
+    def test_host_merge_equals_collective_merge(self):
+        from deequ_tpu.parallel import (
+            collective_merge_states,
+            host_merge_states,
+        )
+        from deequ_tpu.runners.engine import ScanEngine
+
+        rng = np.random.default_rng(3)
+        analyzers = [Size(), Mean("x"), StandardDeviation("x"), Sum("x")]
+        per_shard = []
+        for d in range(5):
+            states, _ = ScanEngine(analyzers).run(
+                Dataset.from_dict({"x": rng.normal(d, 1, 500)})
+            )
+            per_shard.append(states)
+        stacked = tuple(
+            jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[s[i] for s in per_shard],
+            )
+            for i in range(len(analyzers))
+        )
+        collective = collective_merge_states(analyzers, make_mesh(4), stacked)
+        salvage = host_merge_states(analyzers, per_shard)
+        for i, a in enumerate(analyzers):
+            mc = a.compute_metric_from(
+                jax.tree_util.tree_map(np.asarray, collective[i])
+            )
+            ms = a.compute_metric_from(salvage[i])
+            assert ms.value.get() == pytest.approx(
+                mc.value.get(), rel=1e-12
+            ), a
+
+    def test_stack_canonical_roundtrip(self):
+        from deequ_tpu.parallel import (
+            host_merge_states,
+            stack_canonical_states,
+        )
+        from deequ_tpu.runners.engine import ScanEngine
+
+        analyzers = [Size(), Sum("x")]
+        states, _ = ScanEngine(analyzers).run(
+            Dataset.from_dict({"x": np.arange(100, dtype=np.float64)})
+        )
+        canonical = tuple(
+            jax.tree_util.tree_map(np.asarray, s) for s in states
+        )
+        stacked = stack_canonical_states(analyzers, canonical, 4)
+        shard_states = [
+            tuple(
+                jax.tree_util.tree_map(lambda x, _d=d: np.asarray(x[_d]), t)
+                for t in stacked
+            )
+            for d in range(4)
+        ]
+        merged = host_merge_states(analyzers, shard_states)
+        assert int(np.asarray(merged[0].num_matches)) == 100
+        assert float(np.asarray(merged[1].total)) == pytest.approx(4950.0)
+
+    def test_next_rung(self):
+        from deequ_tpu.parallel import next_rung
+
+        assert next_rung((8, 4, 2, 1), 7) == 4
+        assert next_rung((8, 4, 2, 1), 4) == 4
+        assert next_rung((8, 4, 2, 1), 1) == 1
+        assert next_rung((8, 4), 3) is None
+        assert next_rung((8, 4, 2, 1), 0) is None

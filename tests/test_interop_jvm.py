@@ -112,3 +112,214 @@ class TestMalformedBlobs:
             write_jvm_hll_state_blob(
                 type("S", (), {"registers": np.zeros(7, dtype=np.int32)})()
             )
+
+
+# ---------------------------------------------------------------------------
+# Second leg (ISSUE 7): the KLL sketch codec (KLLSketchSerializer.scala
+# layout + KLLState's global min/max trailer)
+# ---------------------------------------------------------------------------
+
+
+def _kll_state(rows=20_000, sketch_size=64, seed=5):
+    import jax.numpy as jnp
+
+    from deequ_tpu.ops.kll import kll_init, kll_update
+
+    rng = np.random.default_rng(seed)
+    state = kll_init(sketch_size)
+    for _ in range(5):
+        values = rng.normal(0, 10, rows // 5)
+        state = kll_update(
+            state, jnp.asarray(values), jnp.ones(len(values), dtype=bool)
+        )
+    return state
+
+
+class TestKLLBlob:
+    def test_round_trip_preserves_sketch_contents(self):
+        from deequ_tpu.interop import (
+            read_jvm_kll_state_blob,
+            write_jvm_kll_state_blob,
+        )
+
+        state = _kll_state()
+        blob = write_jvm_kll_state_blob(state, shrinking_factor=0.64)
+        back, shrinking = read_jvm_kll_state_blob(blob)
+        assert shrinking == 0.64
+        assert back.sketch_size == state.sketch_size
+        assert int(back.count) == int(state.count)
+        assert float(back.g_min) == float(state.g_min)
+        assert float(back.g_max) == float(state.g_max)
+        assert np.array_equal(np.asarray(back.sizes), np.asarray(state.sizes))
+        assert np.array_equal(
+            np.asarray(back.parity), np.asarray(state.parity)
+        )
+        sizes = np.asarray(state.sizes)
+        for level in range(len(sizes)):
+            n = int(sizes[level])
+            assert np.array_equal(
+                np.asarray(back.items)[level, :n],
+                np.asarray(state.items)[level, :n],
+            ), level
+
+    def test_round_trip_quantiles_identical(self):
+        from deequ_tpu.interop import (
+            read_jvm_kll_state_blob,
+            write_jvm_kll_state_blob,
+        )
+        from deequ_tpu.ops.kll_host import HostKLL
+
+        state = _kll_state()
+        back, _ = read_jvm_kll_state_blob(write_jvm_kll_state_blob(state))
+        a, b = HostKLL.from_state(state), HostKLL.from_state(back)
+        for q in (0.0, 0.1, 0.5, 0.9, 1.0):
+            assert a.quantile(q) == b.quantile(q), q
+
+    def test_header_layout_pinned(self):
+        """int32 sketchSize, float64 shrinkingFactor, int64 count, int32
+        compactor count — big-endian DataOutputStream conventions."""
+        from deequ_tpu.interop import write_jvm_kll_state_blob
+        from deequ_tpu.ops.kll import kll_init
+
+        blob = write_jvm_kll_state_blob(kll_init(128), shrinking_factor=0.5)
+        sketch_size, shrink, count, n_comp = struct.unpack_from(">idqi", blob, 0)
+        assert (sketch_size, shrink, count, n_comp) == (128, 0.5, 0, 0)
+        # empty sketch: header + max/min trailer only
+        assert len(blob) == struct.calcsize(">idqi") + 16
+
+    def test_malformed_blobs_typed(self):
+        from deequ_tpu.interop import (
+            read_jvm_kll_state_blob,
+            write_jvm_kll_state_blob,
+        )
+
+        blob = write_jvm_kll_state_blob(_kll_state())
+        for bad in (b"", blob[:8], blob[:-3], blob + b"\x00"):
+            with pytest.raises(CorruptStateError):
+                read_jvm_kll_state_blob(bad)
+        # implausible header fields are structural violations too
+        bad_sketch = struct.pack(">idqi", -5, 0.64, 0, 0) + b"\x00" * 16
+        with pytest.raises(CorruptStateError):
+            read_jvm_kll_state_blob(bad_sketch)
+        bad_shrink = struct.pack(">idqi", 64, 7.5, 0, 0) + b"\x00" * 16
+        with pytest.raises(CorruptStateError):
+            read_jvm_kll_state_blob(bad_shrink)
+
+
+# ---------------------------------------------------------------------------
+# Third leg (ISSUE 7): the Gson metrics-history JSON dialect
+# (AnalysisResultSerde.scala)
+# ---------------------------------------------------------------------------
+
+
+class TestGsonMetricsHistory:
+    def _history(self):
+        from deequ_tpu.analyzers import Mean, Size, Uniqueness
+        from deequ_tpu.repository import AnalysisResult, ResultKey
+
+        data = Dataset.from_dict(
+            {
+                "x": np.arange(200, dtype=np.float64),
+                "y": (np.arange(200) % 9).astype(np.float64),
+            }
+        )
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Size(), Mean("x"), Uniqueness(("x", "y"))]
+        )
+        return [
+            AnalysisResult(ResultKey(1111, {"env": "prod"}), ctx),
+            AnalysisResult(ResultKey(2222, {"env": "dev"}), ctx),
+        ]
+
+    def test_round_trip(self):
+        from deequ_tpu.analyzers import Mean, Size, Uniqueness
+        from deequ_tpu.interop import (
+            read_jvm_metrics_history_json,
+            write_jvm_metrics_history_json,
+        )
+
+        history = self._history()
+        payload = write_jvm_metrics_history_json(history)
+        back = read_jvm_metrics_history_json(payload)
+        assert [r.result_key.data_set_date for r in back] == [1111, 2222]
+        assert back[0].result_key.tags_dict == {"env": "prod"}
+        want = history[0].analyzer_context
+        got = back[0].analyzer_context
+        for a in (Size(), Mean("x"), Uniqueness(("x", "y"))):
+            assert got.metric(a).value.get() == want.metric(a).value.get(), a
+
+    def test_jvm_dialect_shape(self):
+        """No formatVersion/checksum envelope, successful metrics only,
+        and the reference's literal 'Mutlicolumn' entity spelling."""
+        import json
+
+        from deequ_tpu.interop import write_jvm_metrics_history_json
+
+        payload = write_jvm_metrics_history_json(self._history())
+        assert "formatVersion" not in payload
+        assert "checksum" not in payload
+        assert "Mutlicolumn" in payload  # the reference's famous typo
+        records = json.loads(payload)
+        assert isinstance(records, list) and len(records) == 2
+        assert set(records[0]) == {"resultKey", "analyzerContext"}
+
+    def test_failure_metrics_skipped_on_write(self):
+        from deequ_tpu.analyzers import Completeness, Size
+        from deequ_tpu.interop import (
+            read_jvm_metrics_history_json,
+            write_jvm_metrics_history_json,
+        )
+        from deequ_tpu.repository import AnalysisResult, ResultKey
+
+        data = Dataset.from_dict({"x": np.arange(10, dtype=np.float64)})
+        # Completeness over a MISSING column precondition-fails -> Failure
+        ctx = AnalysisRunner.do_analysis_run(
+            data, [Size(), Completeness("nope")]
+        )
+        assert ctx.metric(Completeness("nope")).value.is_failure
+        payload = write_jvm_metrics_history_json(
+            [AnalysisResult(ResultKey(1), ctx)]
+        )
+        back = read_jvm_metrics_history_json(payload)
+        metric_map = back[0].analyzer_context.metric_map
+        assert len(metric_map) == 1  # only the successful Size survived
+
+    def test_reference_written_payload_loads(self):
+        """A hand-written JVM-side payload (the dialect a Gson
+        AnalysisResultSerde emits) loads without our envelope fields."""
+        from deequ_tpu.interop import read_jvm_metrics_history_json
+
+        payload = (
+            '[{"resultKey": {"dataSetDate": 1630000000000, '
+            '"tags": {"table": "orders"}}, '
+            '"analyzerContext": {"metricMap": ['
+            '{"analyzer": {"analyzerName": "Size", "where": null}, '
+            '"metric": {"entity": "Dataset", "instance": "*", '
+            '"name": "Size", "metricName": "DoubleMetric", "value": 42.0}}, '
+            '{"analyzer": {"analyzerName": "Uniqueness", '
+            '"columns": ["a", "b"]}, '
+            '"metric": {"entity": "Mutlicolumn", "instance": "a,b", '
+            '"name": "Uniqueness", "metricName": "DoubleMetric", '
+            '"value": 0.25}}]}}]'
+        )
+        results = read_jvm_metrics_history_json(payload)
+        assert results[0].result_key.data_set_date == 1630000000000
+        values = {
+            type(a).__name__: m.value.get()
+            for a, m in results[0].analyzer_context.metric_map.items()
+        }
+        assert values == {"Size": 42.0, "Uniqueness": 0.25}
+
+    def test_corrupt_payloads_typed(self):
+        from deequ_tpu.interop import read_jvm_metrics_history_json
+
+        for bad in (
+            "{not json",
+            '{"a": 1}',
+            '[{"resultKey": {}}]',
+            '[{"resultKey": {"dataSetDate": 1}, "analyzerContext": '
+            '{"metricMap": [{"analyzer": {"analyzerName": "NoSuch"}, '
+            '"metric": {}}]}}]',
+        ):
+            with pytest.raises(CorruptStateError):
+                read_jvm_metrics_history_json(bad)

@@ -1,0 +1,207 @@
+"""Ports of the reference ``StateAggregationTests.scala`` merge scenarios
+against the mesh-path merge machinery: ``collective_merge_states`` (the
+butterfly the sharded scan uses) and ``host_merge_states`` (the elastic
+layer's salvage merge). The reference proves state aggregation is exact by
+comparing a full-data run against ``runOnAggregatedStates`` over partition
+states; here every scenario additionally pins that BOTH merge
+implementations agree — the salvage path must never drift from the
+collective it substitutes for.
+
+Scenarios: cross-partition equivalence (full == merge of partitions),
+merge-of-merges associativity, and empty-state identity (merging with an
+``init_state`` changes nothing) — the algebra the whole elastic-mesh
+recovery story rests on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Distinctness,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+from deequ_tpu.data import Dataset
+from deequ_tpu.parallel import (
+    collective_merge_states,
+    host_merge_states,
+    make_mesh,
+)
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.engine import ScanEngine
+
+pytestmark = pytest.mark.mesh
+
+SCAN_ANALYZERS = [
+    Size(),
+    Completeness("att1"),
+    Mean("price"),
+    Sum("price"),
+    Minimum("price"),
+    Maximum("price"),
+    StandardDeviation("price"),
+    ApproxCountDistinct("att1"),
+    KLLSketch("price", KLLParameters(128, 0.64, 10)),
+]
+
+
+def _partitions():
+    """Three uneven partitions of one logical dataset (the reference's
+    data/dataUpdated split, widened to exercise >2-way merges)."""
+    rng = np.random.default_rng(42)
+    parts = []
+    for i, rows in enumerate((900, 1700, 400)):
+        import pyarrow as pa
+
+        price = rng.normal(50 + 10 * i, 12, rows)
+        att1 = rng.integers(0, 40, rows).astype(np.float64)
+        parts.append(
+            Dataset.from_arrow(
+                pa.table(
+                    {
+                        "price": pa.array(price),
+                        "att1": pa.array(
+                            att1, mask=rng.random(rows) < 0.08
+                        ),
+                    }
+                )
+            )
+        )
+    return parts
+
+
+def _full(parts):
+    import pyarrow as pa
+
+    return Dataset.from_arrow(
+        pa.concat_tables([p.arrow for p in parts])
+    )
+
+
+def _partition_states(parts):
+    out = []
+    for p in parts:
+        states, _ = ScanEngine(SCAN_ANALYZERS).run(p)
+        out.append(tuple(states))
+    return out
+
+
+def _stack(shard_states):
+    return tuple(
+        jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[s[i] for s in shard_states],
+        )
+        for i in range(len(SCAN_ANALYZERS))
+    )
+
+
+def _metric(analyzer, state):
+    return analyzer.compute_metric_from(
+        jax.tree_util.tree_map(np.asarray, state)
+    )
+
+
+def _assert_metric_equal(analyzer, got_state, want_metric, rel=1e-9):
+    got = _metric(analyzer, got_state).value.get()
+    want = want_metric.value.get()
+    if isinstance(analyzer, KLLSketch):
+        assert sum(b.count for b in got.buckets) == sum(
+            b.count for b in want.buckets
+        )
+    else:
+        assert got == pytest.approx(want, rel=rel), analyzer
+
+
+class TestCrossPartitionEquivalence:
+    """Reference: 'correctly aggregate <analyzer> states' — metrics from
+    merged partition states equal the full-data run's."""
+
+    def test_collective_and_salvage_merges_match_full_run(self):
+        parts = _partitions()
+        full_ctx = AnalysisRunner.do_analysis_run(_full(parts), SCAN_ANALYZERS)
+        shard_states = _partition_states(parts)
+        collective = collective_merge_states(
+            SCAN_ANALYZERS, make_mesh(4), _stack(shard_states)
+        )
+        salvage = host_merge_states(SCAN_ANALYZERS, shard_states)
+        for i, a in enumerate(SCAN_ANALYZERS):
+            want = full_ctx.metric(a)
+            _assert_metric_equal(a, collective[i], want)
+            _assert_metric_equal(a, salvage[i], want)
+
+    def test_aggregated_states_runner_equivalence(self):
+        """The reference's own aggregation surface
+        (``runOnAggregatedStates``) agrees with the full run for grouping
+        analyzers too (Uniqueness/Distinctness ride FrequenciesAndNumRows
+        states, merged via outer-join adds)."""
+        parts = _partitions()
+        analyzers = [
+            Size(), Distinctness(("att1",)), Uniqueness(("att1",)),
+        ]
+        providers = []
+        for p in parts:
+            prov = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(
+                p, analyzers, save_states_with=prov
+            )
+            providers.append(prov)
+        merged_ctx = AnalysisRunner.run_on_aggregated_states(
+            parts[0].schema, analyzers, providers
+        )
+        full_ctx = AnalysisRunner.do_analysis_run(_full(parts), analyzers)
+        for a in analyzers:
+            assert merged_ctx.metric(a).value.get() == pytest.approx(
+                full_ctx.metric(a).value.get(), rel=1e-9
+            ), a
+
+
+class TestMergeAlgebra:
+    def test_merge_of_merges_associativity(self):
+        """(a + b) + c == a + (b + c) == collective([a, b, c]) — the
+        property that makes salvage-then-replay legal at any point in the
+        fold."""
+        shard_states = _partition_states(_partitions())
+        a_states, b_states, c_states = shard_states
+        for i, analyzer in enumerate(SCAN_ANALYZERS):
+            left = analyzer.merge(
+                analyzer.merge(a_states[i], b_states[i]), c_states[i]
+            )
+            right = analyzer.merge(
+                a_states[i], analyzer.merge(b_states[i], c_states[i])
+            )
+            collective = collective_merge_states(
+                SCAN_ANALYZERS, make_mesh(2), _stack(shard_states)
+            )[i]
+            want = _metric(analyzer, left)
+            _assert_metric_equal(analyzer, right, want, rel=1e-12)
+            _assert_metric_equal(analyzer, collective, want, rel=1e-12)
+
+    def test_empty_state_identity(self):
+        """Merging with ``init_state`` is the identity — what makes both
+        shard-dim padding and the salvage re-stack ([merged, ident, ...])
+        exact rather than approximate."""
+        shard_states = _partition_states(_partitions())
+        for i, analyzer in enumerate(SCAN_ANALYZERS):
+            state = shard_states[1][i]
+            want = _metric(analyzer, state)
+            merged_r = analyzer.merge(state, analyzer.init_state())
+            merged_l = analyzer.merge(analyzer.init_state(), state)
+            _assert_metric_equal(analyzer, merged_r, want, rel=1e-12)
+            _assert_metric_equal(analyzer, merged_l, want, rel=1e-12)
+
+    def test_salvage_merge_of_empty_shard_list_is_identity(self):
+        states = host_merge_states(SCAN_ANALYZERS, [])
+        assert int(np.asarray(states[0].num_matches)) == 0

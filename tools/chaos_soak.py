@@ -171,6 +171,7 @@ def run_soak(
                 session.batches_ingested == summary["stream_folds_ok"]
             )
             summary["repo_drill"] = _repository_drill(data, state_root)
+            summary["mesh_drill"] = _mesh_drill(data)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -187,8 +188,54 @@ def run_soak(
         and summary["stream_fold_parity"]
         and summary["succeeded"] + summary["typed_failures"] == jobs
         and summary["repo_drill"]["ok"]
+        and summary["mesh_drill"]["ok"]
     )
     return summary
+
+
+def _mesh_drill(data) -> Dict:
+    """Kill-one-shard drill, run inside the soak: a small sharded battery
+    takes an injected ``mesh_loss`` on its mesh fold and must complete with
+    metrics equal to the clean sharded run (salvage + re-shard, walking to
+    the host tier when only one device exists), with the loss visible on
+    the RunMonitor. ``inject`` swaps the soak's ambient fault plan out for
+    the drill's deterministic one and restores it after."""
+    import jax
+
+    from deequ_tpu.analyzers import Completeness, Mean, Size
+    from deequ_tpu.parallel import make_mesh
+    from deequ_tpu.reliability import FaultSpec, inject
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+    from deequ_tpu.runners.engine import RunMonitor
+
+    n_dev = min(4, len(jax.devices()))
+    analyzers = [Size(), Completeness("x"), Mean("x")]
+    clean = AnalysisRunner.do_analysis_run(
+        data, analyzers, batch_size=256, sharding=make_mesh(n_dev),
+        placement="host",
+    )
+    mon = RunMonitor()
+    with inject(
+        FaultSpec("sharded_fold", "mesh_loss", at=1, shard=n_dev - 1)
+    ) as inj:
+        lossy = AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=256, sharding=make_mesh(n_dev),
+            placement="host", monitor=mon,
+        )
+    parity = all(
+        abs(clean.metric(a).value.get() - lossy.metric(a).value.get())
+        <= 1e-9 * max(1.0, abs(clean.metric(a).value.get()))
+        for a in analyzers
+    )
+    return {
+        "devices": n_dev,
+        "faults_fired": len(inj.fired),
+        "shard_losses": mon.shard_losses,
+        "mesh_reshards": mon.mesh_reshards,
+        "salvaged_states": mon.salvaged_states,
+        "parity": parity,
+        "ok": parity and mon.shard_losses >= 1 and mon.mesh_reshards >= 1,
+    }
 
 
 def _write_trace_artifact(tmpdir: str) -> Dict:

@@ -15,6 +15,18 @@ equal the single-process host-tier fold of the same data.
 Run: ``python -m tools.dcn_smoke`` (exit 0 = parity; 2 = environment
 cannot run multi-process CPU collectives, reported as skipped).
 The slow-marked ``tests/test_dcn_smoke.py`` drives this entry point.
+
+Kill-one-process drill (``python -m tools.dcn_smoke --drill kill-one``):
+the PROCESS-loss leg of the elastic mesh contract. Both workers fold the
+first half of the batches over the 2-device DCN mesh, then the parent
+SIGKILLs worker 1 mid-fold. The survivor detects the dead peer (its next
+cross-process step fails or exceeds a deadline), salvages its OWN shard's
+folded state (the peer's shard died with the peer), replays exactly the
+batch slices the dead shard owned from its local data copy — eager
+host-side semigroup folds, no collectives, because the mesh is gone — and
+completes the fold. Exit 0 iff the survivor's salvaged metrics equal the
+single-process oracle to 1e-9 relative (the same parity bar as the main
+smoke).
 """
 
 from __future__ import annotations
@@ -138,13 +150,246 @@ def worker(process_id: int, port: int) -> None:
     )
 
 
+def drill_worker(process_id: int, port: int, barrier_dir: str) -> None:
+    """One worker of the kill-one drill. Worker 1 is SIGKILLed by the
+    parent after the first chunk folds; worker 0 survives, salvages and
+    finishes. Prints a JSON result line (worker 0 only)."""
+    import time
+
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+
+    import numpy as np
+
+    from deequ_tpu.analyzers.base import HostBatchContext
+    from deequ_tpu.parallel import (
+        collective_merge_states,
+        make_mesh,
+        sharded_ingest_fold,
+        stack_identity_states,
+    )
+
+    analyzers = _battery()
+    data = _data(ROWS)
+    partials = []
+    for index, batch in enumerate(
+        data.batches(ROWS // BATCHES, pad_to_batch_size=False)
+    ):
+        ctx = HostBatchContext(batch, batch_index=index)
+        partials.append(tuple(a.host_partial(ctx) for a in analyzers))
+
+    def stack(group):
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[p[i] for p in group],
+            )
+            for i in range(len(analyzers))
+        )
+
+    half = len(partials) // 2
+    chunks = [partials[:half], partials[half:]]
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)  # 2: one device per process
+    local = half // n_dev
+    states = stack_identity_states(analyzers, n_dev)
+    flags = np.ones(half, dtype=bool)
+
+    # chunk 1 folds on the healthy mesh
+    states = sharded_ingest_fold(analyzers, mesh, states, stack(chunks[0]), flags)
+    jax.block_until_ready(jax.tree_util.tree_leaves(states))
+    #: batch indices THIS process's device (shard = process_id) folded
+    owned = set(range(process_id * local, (process_id + 1) * local))
+    open(os.path.join(barrier_dir, f"w{process_id}-fold1"), "w").write("ok")
+
+    if process_id == 1:
+        time.sleep(120)  # the parent SIGKILLs us here
+        os._exit(3)  # noqa: SLF001 - never reached in the drill
+
+    # worker 0: wait until the parent confirms the kill, then proceed
+    killed = os.path.join(barrier_dir, "killed")
+    for _ in range(600):
+        if os.path.exists(killed):
+            break
+        time.sleep(0.1)
+
+    def with_deadline(fn, seconds: float):
+        """Run fn on a daemon thread; (value, error, timed_out)."""
+        import threading
+
+        box: dict = {}
+        done = threading.Event()
+
+        def body():
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # noqa: BLE001
+                box["error"] = exc
+            finally:
+                done.set()
+
+        threading.Thread(target=body, daemon=True).start()
+        timed_out = not done.wait(seconds)
+        return box.get("value"), box.get("error"), timed_out
+
+    # attempt chunk 2 + the collective merge against the dead peer: either
+    # step failing (or hanging past the deadline) IS the loss signal
+    salvage_reason = None
+
+    def fold2():
+        out = sharded_ingest_fold(
+            analyzers, mesh, states, stack(chunks[1]), flags
+        )
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        return out
+
+    folded2, err, timed_out = with_deadline(fold2, 15.0)
+    if folded2 is not None:
+        states = folded2
+        owned |= set(range(half + 0 * local, half + local))
+        merged, err, timed_out = with_deadline(
+            lambda: collective_merge_states(analyzers, mesh, states), 15.0
+        )
+        if merged is not None:
+            # the dead peer did not block the merge (environment folded it
+            # locally) — still a pass, but record that no salvage was needed
+            print(json.dumps({
+                "process": 0, "salvaged": False,
+                "values": _metric_values(analyzers, merged),
+            }), flush=True)
+            os._exit(0)  # noqa: SLF001 - skip wedged distributed teardown
+        salvage_reason = (
+            "merge timed out" if timed_out else f"merge failed: {err}"
+        )
+    else:
+        salvage_reason = (
+            "fold timed out" if timed_out else f"fold failed: {err}"
+        )
+
+    # SALVAGE: this process's addressable shard of the folded states is the
+    # surviving state; every batch it does NOT cover replays from the local
+    # data copy with eager host-side semigroup folds (the mesh is gone)
+    def local_shard(tree):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x.addressable_data(0))[0]
+            if isinstance(x, jax.Array) and not x.is_fully_addressable
+            else np.asarray(x[0]),
+            tree,
+        )
+
+    salvaged = tuple(local_shard(tree) for tree in states)
+    replay = [i for i in range(len(partials)) if i not in owned]
+    finished = []
+    for i, a in enumerate(analyzers):
+        acc = salvaged[i]
+        for j in replay:
+            acc = a.ingest_partial(acc, partials[j][i])
+        finished.append(acc)
+    print(json.dumps({
+        "process": 0, "salvaged": True, "salvage_reason": salvage_reason,
+        "replayed_batches": len(replay),
+        "values": _metric_values(analyzers, tuple(finished)),
+    }), flush=True)
+    os._exit(0)  # noqa: SLF001 - the distributed runtime lost its peer;
+    # a normal exit would hang in teardown barriers
+
+
+def run_kill_one_drill() -> int:
+    """Parent side of the kill-one drill (see module docstring)."""
+    import signal
+    import tempfile
+    import time
+
+    expected = single_process_expected()
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    barrier_dir = tempfile.mkdtemp(prefix="dcn-drill-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tools.dcn_smoke", "--worker", str(i),
+             "--port", str(port), "--drill", "kill-one",
+             "--barrier", barrier_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
+    # wait for worker 1's first fold, then SIGKILL it mid-fold
+    w1_folded = os.path.join(barrier_dir, "w1-fold1")
+    deadline = time.monotonic() + 240
+    while not os.path.exists(w1_folded):
+        if time.monotonic() > deadline or any(
+            p.poll() is not None for p in procs
+        ):
+            for p in procs:
+                p.kill()
+            errs = [p.communicate()[1].decode()[-400:] for p in procs]
+            print(json.dumps({
+                "ok": False, "skipped": True, "drill": "kill-one",
+                "reason": f"workers never reached fold 1: {errs}",
+            }))
+            return 2
+        time.sleep(0.1)
+    procs[1].send_signal(signal.SIGKILL)
+    procs[1].wait()
+    open(os.path.join(barrier_dir, "killed"), "w").write("ok")
+
+    try:
+        out, err = procs[0].communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out, err = procs[0].communicate()
+    if procs[0].returncode != 0:
+        print(json.dumps({
+            "ok": False, "skipped": True, "drill": "kill-one",
+            "reason": f"survivor rc={procs[0].returncode}: "
+                      f"{err.decode()[-400:]}",
+        }))
+        return 2
+    result = json.loads(out.decode().strip().splitlines()[-1])
+    tol = 1e-9
+    mismatches = [
+        (key, result["values"][key], want)
+        for key, want in expected.items()
+        if abs(result["values"][key] - want) > tol * max(1.0, abs(want))
+    ]
+    ok = not mismatches
+    print(json.dumps({
+        "ok": ok, "skipped": False, "drill": "kill-one",
+        "salvaged": result.get("salvaged"),
+        "salvage_reason": result.get("salvage_reason"),
+        "replayed_batches": result.get("replayed_batches"),
+        "mismatches": mismatches, "expected": expected,
+    }))
+    return 0 if ok else 1
+
+
 def main() -> int:
     if "--worker" in sys.argv:
+        if "--drill" in sys.argv:
+            drill_worker(
+                int(sys.argv[sys.argv.index("--worker") + 1]),
+                int(sys.argv[sys.argv.index("--port") + 1]),
+                sys.argv[sys.argv.index("--barrier") + 1],
+            )
+            return 0
         worker(
             int(sys.argv[sys.argv.index("--worker") + 1]),
             int(sys.argv[sys.argv.index("--port") + 1]),
         )
         return 0
+    if "--drill" in sys.argv:
+        return run_kill_one_drill()
 
     expected = single_process_expected()
     with socket.socket() as probe:

@@ -156,6 +156,116 @@ def time_ingest(analyzers, mesh, n_chunks: int = 5, chunk: int = 32) -> float:
     return (time.perf_counter() - t0) / (n_chunks - 1)
 
 
+def scan_battery():
+    """A lighter battery for end-to-end scan-throughput points (the full
+    27-analyzer battery above stays for the merge timings)."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+
+    return [
+        Size(), Completeness("x0"), Mean("x0"), Sum("x0"), Minimum("x0"),
+        Maximum("x1"), StandardDeviation("x1"), ApproxCountDistinct("x2"),
+    ]
+
+
+def scan_scaling(
+    rows: int = 2_000_000,
+    mesh_sizes=(1, 2, 4, 8),
+    chaos: bool = True,
+) -> dict:
+    """ROADMAP item 2's acceptance artifact: end-to-end sharded-scan
+    throughput at 1/2/4/8 devices (host-tier partials + mesh ingest fold +
+    collective merge — the elastic path), plus a CHAOS point that kills
+    one shard mid-stage and records the recovery wall-time and parity.
+
+    Returns a JSON-able dict: ``points`` maps device count -> rows/s,
+    ``chaos`` carries the kill-one-shard drill (recovery seconds = lossy
+    minus clean wall time at the same mesh size; ``parity_ok`` asserts the
+    degraded run's metrics equal the clean run's)."""
+    import time as _time
+
+    import numpy as np
+
+    import jax
+
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.parallel import make_mesh
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.engine import RunMonitor
+
+    rng = np.random.default_rng(7)
+    data = Dataset.from_dict(
+        {
+            "x0": rng.normal(5, 2, rows),
+            "x1": rng.normal(-3, 9, rows),
+            "x2": rng.integers(0, 10_000, rows).astype(np.float64),
+        }
+    )
+    analyzers = scan_battery()
+    n_avail = len(jax.devices())
+    batch = max(1 << 12, rows // 64)
+    out: dict = {"rows": rows, "points": {}, "devices_available": n_avail}
+    clean_8 = None
+    for n_dev in mesh_sizes:
+        if n_dev > n_avail:
+            continue
+        mesh = make_mesh(n_dev)
+        # warm (compile) pass, then the measured pass
+        AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=batch, sharding=mesh,
+            placement="host",
+        )
+        t0 = _time.perf_counter()
+        ctx = AnalysisRunner.do_analysis_run(
+            data, analyzers, batch_size=batch, sharding=mesh,
+            placement="host",
+        )
+        seconds = _time.perf_counter() - t0
+        out["points"][str(n_dev)] = rows / seconds
+        if n_dev == max(s for s in mesh_sizes if s <= n_avail):
+            clean_8 = (n_dev, seconds, ctx)
+    if chaos and clean_8 is not None and clean_8[0] > 1:
+        from deequ_tpu.reliability import FaultSpec, inject
+
+        n_dev, clean_s, clean_ctx = clean_8
+        mon = RunMonitor()
+        t0 = _time.perf_counter()
+        with inject(
+            FaultSpec("sharded_fold", "mesh_loss", at=2, shard=n_dev - 1)
+        ) as inj:
+            lossy = AnalysisRunner.do_analysis_run(
+                data, analyzers, batch_size=batch, sharding=make_mesh(n_dev),
+                placement="host", monitor=mon,
+            )
+        lossy_s = _time.perf_counter() - t0
+        parity_ok = True
+        for a in analyzers:
+            cv = clean_ctx.metric(a).value.get()
+            lv = lossy.metric(a).value.get()
+            if abs(cv - lv) > 1e-9 * max(1.0, abs(cv)):
+                parity_ok = False
+        out["chaos"] = {
+            "mesh_devices": n_dev,
+            "fault_fired": bool(inj.fired),
+            "clean_s": round(clean_s, 3),
+            "lossy_s": round(lossy_s, 3),
+            "recovery_s": round(max(0.0, lossy_s - clean_s), 3),
+            "shard_losses": mon.shard_losses,
+            "mesh_reshards": mon.mesh_reshards,
+            "salvaged_states": mon.salvaged_states,
+            "parity_ok": parity_ok,
+        }
+    return out
+
+
 def main() -> None:
     from deequ_tpu.parallel import make_mesh
 
@@ -185,4 +295,18 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--stage-json" in sys.argv:
+        # bench.py's mesh_scaling stage entry point: ONE parse-able JSON
+        # line on stdout (scan-scaling points + the kill-one-shard chaos
+        # drill), everything else on stderr
+        import json
+
+        idx = sys.argv.index("--stage-json")
+        rows = (
+            int(sys.argv[idx + 1])
+            if len(sys.argv) > idx + 1 and sys.argv[idx + 1].isdigit()
+            else 2_000_000
+        )
+        print(json.dumps(scan_scaling(rows)), flush=True)
+    else:
+        main()
