@@ -593,26 +593,11 @@ _ENV_WARNED: set = set()
 def _env_int(env: str, default: int) -> int:
     """Validated positive-int env knob: unparseable or non-positive values
     warn ONCE and fall back to the default instead of crashing every pass
-    (the DEEQU_TPU_SCAN_DEADLINE_S / DEEQU_TPU_TRACE precedent)."""
-    import logging
-    import os
+    (the shared `utils.env_number` helper; the DEEQU_TPU_SCAN_DEADLINE_S /
+    DEEQU_TPU_TRACE precedent)."""
+    from ..utils import env_number
 
-    raw = os.environ.get(env)
-    if raw is None:
-        return default
-    try:
-        value = int(raw)
-        if value <= 0:
-            raise ValueError(raw)
-    except ValueError:
-        if env not in _ENV_WARNED:
-            _ENV_WARNED.add(env)
-            logging.getLogger(__name__).warning(
-                "ignoring invalid %s=%r (expected a positive integer); "
-                "using the default %d", env, raw, default,
-            )
-        return default
-    return value
+    return env_number(env, default, int, minimum=1)
 
 
 def device_freq_max_cardinality() -> int:

@@ -105,6 +105,25 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Ingestion plane (implemented in deequ_tpu.ingest; the env knob is
+# documented here with the other operator-facing switches and re-exported
+# below). Follows the warn-and-fallback convention: an unparseable value
+# warns once and keeps the default.
+#
+# - DEEQU_TPU_PREFETCH_DEPTH: staged batches in the double-buffered
+#   host->device feed pipeline (default 2: one batch folding on device,
+#   one staged with its transfer in flight, one being built). "0" removes
+#   the feed thread entirely — batches build and transfer inline on the
+#   consumer thread, the measured "serial" baseline of PERF.md's overlap
+#   numbers. Batch shapes stay pow2-bucketed upstream, so a deeper
+#   pipeline never provokes a recompile.
+# - DEEQU_TPU_FEED_STALL_S: seconds the fold tolerates a SILENT feed
+#   thread before declaring it wedged with a typed FeedStallError
+#   (default 120; <= 0 disables). A tripped deadline fails the pass over
+#   to the host tier exactly like a thrown device fault.
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -154,6 +173,10 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 # - DEEQU_TPU_FLIGHT_DIR: directory receiving flight-record JSONL
 #   artifacts dumped on typed failures (DeviceFailure / ScanStallError /
 #   CorruptStateError / SchemaDriftError). Unset = per-process temp dir.
+from .ingest.prefetch import (  # noqa: E402,F401
+    FEED_STALL_ENV,
+    PREFETCH_DEPTH_ENV,
+)
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401
 from .parallel.health import HEARTBEAT_ENV as SHARD_HEARTBEAT_ENV  # noqa: E402,F401

@@ -1,0 +1,52 @@
+"""High-throughput ingestion plane: socket to device.
+
+The engine scans orders of magnitude faster than the feed links that were
+serving it (ROADMAP item 4: the bench's feed probe read 6-30 MB/s against
+a ~GB/s device appetite). This package is the missing frontend:
+
+- :mod:`.columnar` — in-process coercion: dict-of-numpy / Arrow tables /
+  record batches become :class:`~deequ_tpu.data.Dataset` with no pandas
+  hop (`as_dataset`);
+- :mod:`.arrow_stream` — the zero-copy Arrow IPC wire format: typed,
+  checksummed, fault-injectable frame decode (`iter_frames`) and the
+  per-frame atomic fold into a streaming session (`fold_stream`);
+- :mod:`.endpoint` — the HTTP frontend riding the MetricsExporter plane
+  (``POST /ingest/v1/<tenant>/<dataset>``);
+- :mod:`.prefetch` — the double-buffered host->device feed pipeline the
+  engine's device pass pulls batches through
+  (`PrefetchingBatchIterator`, ``DEEQU_TPU_PREFETCH_DEPTH``).
+"""
+
+from ..exceptions import (
+    FeedDisconnectError,
+    FeedStallError,
+    MalformedFrameError,
+)
+from .arrow_stream import (
+    CHECKSUM_HEADER,
+    IngestReport,
+    encode_ipc_stream,
+    fold_stream,
+    iter_frames,
+)
+from .columnar import as_dataset, payload_bytes
+from .endpoint import INGEST_PREFIX, IngestEndpoint
+from .prefetch import (
+    DEFAULT_FEED_STALL_S,
+    DEFAULT_PREFETCH_DEPTH,
+    FEED_STALL_ENV,
+    PREFETCH_DEPTH_ENV,
+    PrefetchingBatchIterator,
+    feed_stall_s,
+    prefetch_depth,
+)
+
+__all__ = [
+    "as_dataset", "payload_bytes",
+    "encode_ipc_stream", "iter_frames", "fold_stream", "IngestReport",
+    "CHECKSUM_HEADER", "INGEST_PREFIX", "IngestEndpoint",
+    "PrefetchingBatchIterator", "prefetch_depth", "feed_stall_s",
+    "PREFETCH_DEPTH_ENV", "DEFAULT_PREFETCH_DEPTH",
+    "FEED_STALL_ENV", "DEFAULT_FEED_STALL_S",
+    "MalformedFrameError", "FeedDisconnectError", "FeedStallError",
+]

@@ -1,0 +1,214 @@
+"""HTTP ingest endpoint: the Arrow IPC wire frontend on the export plane.
+
+``POST /ingest/v1/<tenant>/<dataset>`` with an Arrow IPC stream body
+folds each record batch into the named streaming session, one atomic
+micro-batch merge per frame, and answers with the fold report as JSON.
+The endpoint rides the existing :class:`~deequ_tpu.service.metrics.
+MetricsExporter` HTTP plane (same server, same port as ``/metrics``), so
+a service that exports metrics already has an ingest socket.
+
+Contract:
+
+- the session must already exist (created by the operator with its
+  checks via ``service.session(...)``): an unknown session is 404 — the
+  endpoint never auto-creates a zero-check session that would verify
+  nothing and always report SUCCESS;
+- ``X-Deequ-Checksum`` (optional) carries the xxhash64 hex digest of the
+  raw body; a mismatch is 400 and nothing folds;
+- bounded admission maps to 429 (``ServiceOverloaded`` — the scheduler
+  shed the fold), schema drift to 409, a closed session to 410, a closed
+  service to 503, malformed frames to 400;
+- a client that disconnects mid-body tears the stream typed: complete
+  leading frames stay committed, the torn tail never folds, and the
+  disconnect is counted (no response can reach a dead client, so the
+  counters + flight record ARE the observable). If the request DECLARED
+  a checksum, a torn body can never verify it — nothing folds at all,
+  because folding unverified frames would bypass the digest tripwire.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional, Tuple
+
+_logger = logging.getLogger(__name__)
+
+from ..exceptions import (
+    FeedDisconnectError,
+    MalformedFrameError,
+    SchemaDriftError,
+)
+from .arrow_stream import (
+    CHECKSUM_HEADER,
+    describe_ingest_metrics,
+    fold_stream,
+)
+
+#: route prefix the exporter dispatches to this endpoint
+INGEST_PREFIX = "/ingest/v1/"
+
+
+def _unquote(component: str) -> str:
+    from urllib.parse import unquote
+
+    return unquote(component)
+
+
+class IngestEndpoint:
+    """Stateless request handler bound to one VerificationService."""
+
+    def __init__(self, service):
+        self.service = service
+        describe_ingest_metrics(service.metrics)
+
+    # -- routing -------------------------------------------------------------
+
+    def matches(self, path: str) -> bool:
+        return path.startswith(INGEST_PREFIX)
+
+    def parse_target(self, path: str) -> Optional[Tuple[str, str]]:
+        rest = path[len(INGEST_PREFIX):]
+        if "?" in rest:
+            rest = rest.split("?", 1)[0]
+        parts = [p for p in rest.split("/") if p]
+        if len(parts) != 2:
+            return None
+        return _unquote(parts[0]), _unquote(parts[1])
+
+    # -- request handling ----------------------------------------------------
+
+    def handle_post(self, path: str, headers, rfile) -> Tuple[int, dict]:
+        """Process one POST; returns ``(http_status, json_body)``. Never
+        raises — every failure mode maps to a typed JSON error body (the
+        transport layer decides whether a client is still there to read
+        it)."""
+        target = self.parse_target(path)
+        if target is None:
+            return 404, {"error": "not_found", "detail": (
+                f"expected {INGEST_PREFIX}<tenant>/<dataset>"
+            )}
+        tenant, dataset = target
+        session = self.service.get_session(tenant, dataset,
+                                           include_closed=True)
+        if session is None:
+            return 404, {"error": "unknown_session", "tenant": tenant,
+                         "dataset": dataset, "detail": (
+                             "create the session (with its checks) via "
+                             "service.session() before feeding it"
+                         )}
+        if session.closed:
+            # "gone", not "never existed": the documented 410 contract —
+            # a producer retrying on 404 by re-registering must NOT be
+            # told to do that for a deliberately closed session
+            return 410, {"error": "session_closed", "tenant": tenant,
+                         "dataset": dataset}
+        metrics = self.service.metrics
+        labels = {"tenant": tenant, "dataset": dataset}
+        try:
+            declared = int(headers.get("Content-Length", "0"))
+        except ValueError:
+            return 411, {"error": "length_required"}
+        if declared <= 0:
+            return 411, {"error": "length_required"}
+        source = f"http:{tenant}/{dataset}"
+        try:
+            body = rfile.read(declared)
+        except OSError:
+            # socket timeout/reset mid-body: whatever partial data the
+            # buffered reader held is gone with the raise — a pure
+            # disconnect, nothing decodable arrived
+            metrics.inc("deequ_service_ingest_disconnects_total", **labels)
+            from ..observability import record_failure
+
+            record_failure(FeedDisconnectError(source, detail="socket error"))
+            return 400, {"error": "feed_disconnect", "received_bytes": 0,
+                         "declared_bytes": declared}
+        checksum = headers.get(CHECKSUM_HEADER)
+        if len(body) < declared:
+            if checksum is not None:
+                # the producer DECLARED a digest and a torn body can
+                # never verify it: folding unverified leading frames
+                # would bypass the exact tripwire the digest exists for
+                # (a flipped byte decodes silently in Arrow IPC), so
+                # nothing folds
+                metrics.inc(
+                    "deequ_service_ingest_disconnects_total", **labels
+                )
+                from ..observability import record_failure
+
+                record_failure(FeedDisconnectError(
+                    source, bytes_read=len(body),
+                    detail="checksummed stream torn; nothing folded",
+                ))
+                return 400, {
+                    "error": "feed_disconnect",
+                    "declared_bytes": declared,
+                    "received_bytes": len(body),
+                    "detail": "declared checksum cannot be verified on a "
+                              "torn body; nothing folded",
+                }
+            # no digest declared: the producer died mid-body — decode
+            # what arrived under the disconnect contract (whole leading
+            # frames fold, torn tail raises typed)
+            try:
+                fold_stream(
+                    session, body, complete=False, source=source,
+                    checksum=None,
+                )
+            except (FeedDisconnectError, MalformedFrameError):
+                pass
+            except Exception:  # noqa: BLE001 - the client is gone; the
+                # counters and flight record carry the outcome
+                _logger.warning(
+                    "ingest %s: error folding truncated body", source,
+                    exc_info=True,
+                )
+            else:
+                # every frame decoded despite the short read (length
+                # header lied high); still a disconnect for accounting
+                metrics.inc(
+                    "deequ_service_ingest_disconnects_total", **labels
+                )
+            return 400, {
+                "error": "feed_disconnect",
+                "declared_bytes": declared, "received_bytes": len(body),
+            }
+        try:
+            report = fold_stream(
+                session, body, checksum=checksum, complete=True,
+                source=source,
+            )
+        except MalformedFrameError as exc:
+            return 400, {"error": "malformed_frame", "detail": str(exc)}
+        except SchemaDriftError as exc:
+            return 409, {"error": "schema_drift", "detail": str(exc)}
+        except Exception as exc:  # noqa: BLE001 - typed service errors
+            from ..service.errors import (
+                JobFailed,
+                JobTimeout,
+                ServiceClosed,
+                ServiceOverloaded,
+                SessionClosed,
+            )
+
+            if isinstance(exc, ServiceOverloaded):
+                metrics.inc("deequ_service_ingest_shed_total", **labels)
+                return 429, {"error": "overloaded", "detail": str(exc)}
+            if isinstance(exc, SessionClosed):
+                return 410, {"error": "session_closed"}
+            if isinstance(exc, ServiceClosed):
+                return 503, {"error": "service_closed"}
+            if isinstance(exc, JobTimeout):
+                return 504, {"error": "fold_timeout", "detail": str(exc)}
+            if isinstance(exc, JobFailed):
+                return 500, {"error": "fold_failed", "detail": str(exc)}
+            _logger.warning(
+                "ingest %s: unexpected failure", source, exc_info=True
+            )
+            return 500, {"error": "internal", "detail": str(exc)}
+        return 200, {"ok": True, **report.to_dict()}
+
+
+def render_response(status: int, body: dict) -> bytes:
+    return json.dumps(body, sort_keys=True).encode()

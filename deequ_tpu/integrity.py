@@ -62,8 +62,9 @@ def warn_once_unchecksummed(kind: str, source: str) -> None:
         )
 
 
-def checksum_bytes(payload: bytes) -> str:
-    """Content checksum of raw bytes, as 16 hex chars.
+def checksum_bytes(payload) -> str:
+    """Content checksum of raw bytes (or any buffer-protocol object —
+    memoryview, arrow buffer — hashed IN PLACE), as 16 hex chars.
 
     Small payloads (< 1 KiB: meta records, repository entries) use the
     canonical scalar xxhash64. Large payloads (state blobs — KLL item
@@ -80,14 +81,19 @@ def checksum_bytes(payload: bytes) -> str:
     function) and pinned by tests."""
     n = len(payload)
     if n < _VECTOR_THRESHOLD:
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)  # sub-KiB: the copy is trivial
         return f"{xxhash64_bytes(payload, CHECKSUM_SEED):016x}"
+    # accepts any buffer-protocol object (bytes, memoryview, arrow
+    # buffer): np.frombuffer reads in place, so hashing a gigabyte ingest
+    # payload never materializes a second copy of it
     words = np.frombuffer(payload, dtype="<u8", count=n // 8)
     with np.errstate(over="ignore"):
         tagged = words ^ (
             np.arange(words.size, dtype=np.uint64) * _POS_PRIME
         )
         combined = np.bitwise_xor.reduce(xxhash64_u64(tagged, CHECKSUM_SEED))
-    tail = payload[(n // 8) * 8:]
+    tail = bytes(memoryview(payload)[(n // 8) * 8:])
     final = xxhash64_bytes(
         int(combined).to_bytes(8, "little") + tail + n.to_bytes(8, "little"),
         CHECKSUM_SEED,

@@ -37,12 +37,21 @@ site                 fires
 ``repository_load``  in the FS metrics repository's read-all, tag = path
 ``stream_fold``      before a streaming session's fold mutates state
 ``shard_probe``      per mesh shard in the heartbeat health probe, tag = shard
+``frame_decode``     per ingest-plane frame before it folds, tag = frame idx
+``prefetch``         per staged batch in the device feed pipeline, tag = idx
 ===================  ========================================================
 
 The ``corrupt`` kind (a typed ``CorruptStateError``) injected at the three
 load sites stands in for bit rot/torn writes the checksum layer would
 detect; ``drift`` (a typed ``SchemaDriftError``) at ``stream_fold`` stands
 in for a micro-batch whose schema drifted from the session contract.
+
+The ingest kinds: ``frame_corrupt`` (a typed ``MalformedFrameError``)
+injected at ``frame_decode`` stands in for torn/garbled Arrow IPC bytes a
+producer shipped; ``feed_stall`` (a typed ``FeedStallError``) at
+``prefetch`` stands in for the device feed pipeline wedging mid-pass —
+with a ``delay_s`` it sleeps that long first, modeling a slow feed before
+the stall is declared.
 
 The mesh kinds: ``mesh_loss`` (a typed ``ShardLossError`` whose ``lost``
 list carries the spec's ``shard``, default 0) stands in for a device or
@@ -116,6 +125,18 @@ def _make_error(
         return CorruptStateError("injected payload", site, note)
     if kind == "drift":
         return SchemaDriftError(site, [note])
+    if kind == "frame_corrupt":
+        from ..exceptions import MalformedFrameError
+
+        try:
+            index = int(tag)
+        except (TypeError, ValueError):
+            index = -1
+        return MalformedFrameError(site, note, frame_index=index)
+    if kind == "feed_stall":
+        from ..exceptions import FeedStallError
+
+        return FeedStallError(site, note)
     if kind == "mesh_loss":
         from ..exceptions import ShardLossError
 
@@ -130,6 +151,7 @@ def _make_error(
 FAULT_KINDS = (
     "device", "oom", "poison", "analyzer", "interrupt", "worker_death",
     "stall", "corrupt", "drift", "mesh_loss", "shard_stall",
+    "frame_corrupt", "feed_stall",
 )
 
 
@@ -223,9 +245,11 @@ class FaultInjector:
                     continue
                 self._spec_fired[i] += 1
                 self._fired.append(f"{site}:{tag}:{spec.kind}")
-                if spec.kind == "stall":
-                    delay = spec.delay_s
-                else:
+                # every kind honors delay_s ("stall" sleeps and nothing
+                # more; other kinds model a SLOW failure — a feed that
+                # drags before wedging — by sleeping, then raising)
+                delay = spec.delay_s
+                if spec.kind != "stall":
                     error = _make_error(spec.kind, site, tag, shard=spec.shard)
                 break
         if delay:

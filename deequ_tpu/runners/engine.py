@@ -1968,13 +1968,16 @@ class ScanEngine:
                 )
                 monitor.bump("checkpoint_saves")
 
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            pending = pool.submit(produce)
-            while True:
-                item = pending.result()
-                if item is None:
-                    break
-                pending = pool.submit(produce)
+        # double-buffered feed pipeline (deequ_tpu.ingest.prefetch): the
+        # feed thread stages batch k+1's feature build + host->device copy
+        # (and with the default depth 2, k+2's) while batch k's fold
+        # executes — transfer time hides under device compute instead of
+        # serializing with it. DEEQU_TPU_PREFETCH_DEPTH=0 restores the
+        # serial path (the measured baseline for the overlap numbers).
+        from ..ingest.prefetch import PrefetchingBatchIterator
+
+        with PrefetchingBatchIterator(produce) as staged:
+            for item in staged:
                 batch, features = item
                 monitor.bump("batches")
                 if features is not None:

@@ -217,6 +217,20 @@ class VerificationService:
             self._sessions[key] = session
             return session
 
+    def get_session(
+        self, tenant: str, dataset: str, include_closed: bool = False
+    ) -> Optional[StreamingSession]:
+        """The LIVE session for (tenant, dataset), or None — a pure
+        lookup, never a create (the ingest endpoint resolves targets with
+        this so an unknown name is a 404, not a silent zero-check
+        session). ``include_closed=True`` also returns a CLOSED session —
+        how the endpoint tells "never existed" (404) from "gone" (410)."""
+        with self._sessions_lock:
+            session = self._sessions.get(session_key(tenant, dataset))
+            if session is None or (session.closed and not include_closed):
+                return None
+            return session
+
     # -- export plane --------------------------------------------------------
 
     def prometheus_text(self) -> str:
@@ -226,8 +240,12 @@ class VerificationService:
         return self.metrics.json_snapshot()
 
     def start_exporter(
-        self, host: str = "127.0.0.1", port: int = 0
+        self, host: str = "127.0.0.1", port: int = 0, ingest: bool = True
     ) -> MetricsExporter:
+        """Serve the HTTP plane: ``/metrics`` + ``/trace`` as before, and
+        (with ``ingest=True``, the default) the Arrow IPC ingest frontend
+        at ``POST /ingest/v1/<tenant>/<dataset>`` bound to this service's
+        streaming sessions."""
         if self._exporter is not None:
             if host != self._exporter.host or port not in (
                 0, self._exporter.port
@@ -240,7 +258,14 @@ class VerificationService:
                     f"rebind to {host}:{port}"
                 )
             return self._exporter
-        self._exporter = MetricsExporter(self.metrics, host=host, port=port)
+        endpoint = None
+        if ingest:
+            from ..ingest import IngestEndpoint
+
+            endpoint = IngestEndpoint(self)
+        self._exporter = MetricsExporter(
+            self.metrics, host=host, port=port, ingest=endpoint
+        )
         return self._exporter
 
     # -- lifecycle -----------------------------------------------------------

@@ -207,20 +207,52 @@ def _format(value: float) -> str:
 
 
 class MetricsExporter:
-    """Serves ``/metrics`` (Prometheus text), ``/metrics.json``, and the
+    """Serves ``/metrics`` (Prometheus text), ``/metrics.json``, the
     trace plane — ``/trace`` (Chrome trace-event / Perfetto-loadable JSON
     of the flight-recorder ring) and ``/trace.jsonl`` (the span journal) —
-    from a daemon thread. Binds to an ephemeral port by default
-    (``port=0``); the bound port is on ``.port``."""
+    and, when constructed with an ``ingest`` endpoint, the Arrow IPC
+    ingestion frontend (``POST /ingest/v1/<tenant>/<dataset>``, see
+    `deequ_tpu.ingest.endpoint`) — from a daemon thread. Binds to an
+    ephemeral port by default (``port=0``); the bound port is on
+    ``.port``."""
 
     def __init__(
-        self, metrics: ServiceMetrics, host: str = "127.0.0.1", port: int = 0
+        self,
+        metrics: ServiceMetrics,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ingest: Optional[Any] = None,
     ):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         plane = metrics
+        ingest_endpoint = ingest
 
         class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if ingest_endpoint is None or not ingest_endpoint.matches(
+                    self.path
+                ):
+                    self.send_error(404)
+                    return
+                from ..ingest.endpoint import render_response
+
+                status, body_dict = ingest_endpoint.handle_post(
+                    self.path, self.headers, self.rfile
+                )
+                body = render_response(status, body_dict)
+                try:
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # a producer that died mid-stream cannot read its
+                    # error; the fold report already landed on the
+                    # counters and flight record
+                    self.close_connection = True
+
             def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
                 if self.path.startswith("/metrics.json"):
                     body = plane.json_text().encode()
@@ -246,6 +278,11 @@ class MetricsExporter:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            # a producer that stops sending mid-body must not pin a
+            # handler thread forever: the socket read times out and the
+            # ingest path records a typed disconnect
+            timeout = 30
 
             def log_message(self, *args):  # quiet: the plane IS the log
                 pass

@@ -156,6 +156,11 @@ class JobScheduler:
         #: serial-key-blocked entries, so a heap's pop-only discipline
         #: would not fit
         self._ready: List[Tuple[int, int, _Job]] = []
+        #: submitters blocked in backpressure mode wait here; workers
+        #: notify it whenever a pickup frees queue space (same lock as
+        #: _cond, separate waiter set so a freed slot wakes a submitter
+        #: instead of another idle worker)
+        self._space = threading.Condition(self._lock)
         #: (not_before, seq, job) — backoff-delayed retries
         self._delayed: List[Tuple[float, int, _Job]] = []
         self._seq = itertools.count()
@@ -268,6 +273,7 @@ class JobScheduler:
         job_id: Optional[str] = None,
         warm_fn: Optional[Callable[[], None]] = None,
         serial_key: Optional[Any] = None,
+        block_s: Optional[float] = None,
     ) -> JobHandle:
         """Admit one job, or shed it with :class:`ServiceOverloaded`.
 
@@ -276,11 +282,28 @@ class JobScheduler:
         1-padded-batch device run that compiles the production program).
         Jobs sharing a ``serial_key`` execute one at a time, in submission
         order within a priority class — the scheduler-level serialization
-        streaming sessions need, without blocking workers on a lock."""
+        streaming sessions need, without blocking workers on a lock.
+
+        ``block_s`` turns admission into BACKPRESSURE for up to that many
+        seconds: a full queue parks the submitter until a worker pickup
+        frees a slot instead of shedding immediately — the semantics a
+        streaming producer wants (slow down, don't drop), bounded so a
+        wedged service still sheds typed rather than hanging the producer
+        forever. ``None`` (default) keeps the shed-immediately contract."""
         with self._cond:
             if self._closed:
                 raise ServiceClosed("verification service is shut down")
             depth = len(self._ready) + len(self._delayed)
+            if depth >= self.max_queue_depth and block_s:
+                deadline = time.monotonic() + float(block_s)
+                while not self._closed and depth >= self.max_queue_depth:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._space.wait(remaining)
+                    depth = len(self._ready) + len(self._delayed)
+                if self._closed:
+                    raise ServiceClosed("verification service is shut down")
             if depth >= self.max_queue_depth:
                 self.metrics.inc("deequ_service_jobs_shed_total", tenant=tenant)
                 raise ServiceOverloaded(depth, self.max_queue_depth)
@@ -387,6 +410,9 @@ class JobScheduler:
                     # a finishing job notifies, releasing its serial key
                     self._cond.wait(timeout)
                 self._active += 1
+                # the pickup freed a queue slot: wake one blocked
+                # backpressure submitter
+                self._space.notify()
             retried = False
             try:
                 retried = self._execute(job, worker_id)
@@ -607,6 +633,9 @@ class JobScheduler:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+            # backpressure submitters parked on a full queue must wake to
+            # their typed ServiceClosed instead of out-waiting block_s
+            self._space.notify_all()
         if wait:
             deadline = None if timeout is None else time.monotonic() + timeout
             for t in self._workers:

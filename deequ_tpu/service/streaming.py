@@ -86,6 +86,7 @@ class StreamingSession:
         on_result: Optional[Callable[[Any], None]] = None,
         keep_results: int = 256,
         drift_policy: str = "reject",
+        admission_block_s: Optional[float] = None,
     ):
         # max_retries defaults to 0 because a fold MUTATES persisted state:
         # a transient failure in the middle of a run can leave some
@@ -113,6 +114,10 @@ class StreamingSession:
         self.max_retries = max_retries
         self.batch_size = batch_size
         self.on_result = on_result
+        #: seconds an over-quota ingest WAITS for queue space before the
+        #: typed shed (backpressure for streaming producers); None keeps
+        #: the scheduler's shed-immediately default
+        self.admission_block_s = admission_block_s
         from .drift import DRIFT_POLICIES
 
         if drift_policy not in DRIFT_POLICIES:
@@ -146,6 +151,9 @@ class StreamingSession:
         self._submit_seq = itertools.count()
         self.batches_ingested = 0
         self.rows_ingested = 0
+        #: columnar payload bytes folded (wire-equivalent arrow buffer
+        #: sizes — what the ingest plane's MB/s numbers are made of)
+        self.bytes_ingested = 0
         from collections import deque
 
         #: the most recent ``keep_results`` batch results — bounded, so a
@@ -162,20 +170,30 @@ class StreamingSession:
 
     def ingest(
         self,
-        data: Dataset,
+        data,
         *,
         wait: bool = True,
         timeout: Optional[float] = None,
         deadline_s: Optional[float] = None,
+        block_s: Optional[float] = None,
     ):
         """Fold one micro-batch into the session's persisted states and
         evaluate the checks on the merged (cumulative) metrics.
+
+        ``data`` is any columnar payload `deequ_tpu.ingest.as_dataset`
+        accepts: a :class:`Dataset`, a pyarrow ``Table``/``RecordBatch``,
+        a **dict of numpy arrays** (zero-copy for numeric dtypes — the
+        recommended in-process shape; no pandas hop), or a pandas
+        DataFrame (the legacy path, which pays the conversion).
 
         With ``wait=True`` (default) returns the batch's
         ``VerificationResult``; with ``wait=False`` returns the
         :class:`JobHandle` so callers can pipeline batches."""
         if self._closed:
             raise SessionClosed(self.tenant, self.dataset)
+        from ..ingest.columnar import as_dataset
+
+        data = as_dataset(data)
         done: dict = {}  # per-job memo: a retried job must never re-fold
         bs = _session_batch_size(int(data.num_rows), self.batch_size)
 
@@ -201,6 +219,9 @@ class StreamingSession:
             # in submission order — pipelined ingests occupy ONE worker and
             # cannot fold out of order (per-batch anomaly attribution)
             serial_key=(self.tenant, self.dataset),
+            # backpressure: wait for queue space up to block_s before the
+            # typed shed (per-call override, else the session default)
+            block_s=block_s if block_s is not None else self.admission_block_s,
         )
         if wait:
             from .errors import JobFailed, JobTimeout
@@ -273,6 +294,9 @@ class StreamingSession:
             self._schema = self._schema or data.schema
             self.batches_ingested += 1
             self.rows_ingested += int(data.num_rows)
+            from ..ingest.columnar import payload_bytes
+
+            self.bytes_ingested += payload_bytes(data)
             self.results.append(result)
             metrics = self.service.metrics
             metrics.inc(

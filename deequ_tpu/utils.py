@@ -58,3 +58,35 @@ class BoundedLRU:
     def clear(self) -> None:
         with self._lock:
             self._data.clear()
+
+
+#: warn-once latch for env_number (one line per env var per process)
+_ENV_WARNED: set = set()
+
+
+def env_number(env: str, default, cast, minimum=None):
+    """The shared "warn once, keep the default" env-knob parser (the
+    DEEQU_TPU_SCAN_DEADLINE_S convention): unparseable values — and, with
+    ``minimum``, out-of-range ones — log ONE warning per process per
+    variable and fall back to ``default`` instead of crashing the path
+    that read them. Knobs whose fallback is not a constant (the watchdog's
+    derived deadline) keep their own parsers."""
+    import logging
+    import os
+
+    raw = os.environ.get(env)
+    if raw is None:
+        return default
+    try:
+        value = cast(raw)
+        if minimum is not None and value < minimum:
+            raise ValueError(raw)
+    except ValueError:
+        if env not in _ENV_WARNED:
+            _ENV_WARNED.add(env)
+            logging.getLogger(__name__).warning(
+                "ignoring invalid %s=%r; using the default %s",
+                env, raw, default,
+            )
+        return default
+    return value

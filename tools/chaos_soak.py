@@ -172,6 +172,7 @@ def run_soak(
             )
             summary["repo_drill"] = _repository_drill(data, state_root)
             summary["mesh_drill"] = _mesh_drill(data)
+            summary["ingest_drill"] = _ingest_drill(service)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -189,6 +190,7 @@ def run_soak(
         and summary["succeeded"] + summary["typed_failures"] == jobs
         and summary["repo_drill"]["ok"]
         and summary["mesh_drill"]["ok"]
+        and summary["ingest_drill"]["ok"]
     )
     return summary
 
@@ -236,6 +238,106 @@ def _mesh_drill(data) -> Dict:
         "parity": parity,
         "ok": parity and mon.shard_losses >= 1 and mon.mesh_reshards >= 1,
     }
+
+
+def _ingest_drill(service) -> Dict:
+    """Arrow ingestion-plane drill, run inside the soak against the live
+    service: a truncated frame and a checksum-corrupted payload must both
+    recover TYPED (FeedDisconnectError / MalformedFrameError) with the
+    torn/corrupt frames never touching session state — complete leading
+    frames stay committed, nothing else folds. An injected
+    ``frame_corrupt`` at the ``frame_decode`` site exercises the same
+    rejection without hand-crafting bytes. ``inject`` swaps the soak's
+    ambient fault plan out for the drill's deterministic one."""
+    import io
+
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.exceptions import FeedDisconnectError, MalformedFrameError
+    from deequ_tpu.ingest import fold_stream
+    from deequ_tpu.integrity import checksum_bytes
+    from deequ_tpu.reliability import FaultSpec, inject
+
+    checks = _checks()
+
+    def frame_table(seed: int, rows: int = 512):
+        r = np.random.default_rng(seed)
+        return pa.table({
+            "x": r.normal(size=rows),
+            "y": r.normal(10.0, 2.0, size=rows),
+            "cat": pa.array([f"c{i % 13}" for i in range(rows)]),
+        })
+
+    # encode incrementally so the drill knows each frame's byte boundary
+    tables = [frame_table(s) for s in (1, 2, 3)]
+    sink = io.BytesIO()
+    boundaries = []
+    with pa.ipc.new_stream(sink, tables[0].schema) as writer:
+        for t in tables:
+            for b in t.to_batches():
+                writer.write_batch(b)
+            boundaries.append(sink.tell())
+    payload = sink.getvalue()
+
+    out: Dict = {}
+    with inject():  # the drill's outcomes are deterministic: swap the
+        # soak's ambient seeded plan out (restored on exit) so an ambient
+        # worker_death/drift hit cannot shift the pinned counts below
+        # 1. clean fold: all three frames commit
+        clean = service.session("ingest-drill", "clean", checks)
+        report = fold_stream(clean, payload, source="drill")
+        out["clean_frames"] = report.frames
+        baseline = clean.batches_ingested
+
+        # 2. mid-stream disconnect: cut inside frame 3 — frames 1-2
+        # commit, the torn tail recovers typed and never folds
+        cut = boundaries[1] + (boundaries[2] - boundaries[1]) // 2
+        torn = service.session("ingest-drill", "torn", checks)
+        try:
+            fold_stream(torn, payload[:cut], complete=False, source="drill")
+            out["disconnect_typed"] = False
+        except FeedDisconnectError:
+            out["disconnect_typed"] = True
+        except MalformedFrameError:
+            out["disconnect_typed"] = False
+        out["torn_committed"] = torn.batches_ingested
+
+        # 3. checksum corruption: one flipped byte inside a buffer body
+        # decodes silently in Arrow IPC — the declared digest is the
+        # tripwire; NOTHING folds
+        bad = bytearray(payload)
+        bad[boundaries[0] + 32] ^= 0xFF
+        corrupt = service.session("ingest-drill", "corrupt", checks)
+        try:
+            fold_stream(
+                corrupt, bytes(bad), checksum=checksum_bytes(payload),
+                source="drill",
+            )
+            out["corrupt_typed"] = False
+        except MalformedFrameError:
+            out["corrupt_typed"] = True
+        out["corrupt_committed"] = corrupt.batches_ingested
+
+    # 4. injected frame_corrupt at frame_decode: second frame rejected
+    # typed, first frame's fold stays committed
+    injected = service.session("ingest-drill", "injected", checks)
+    with inject(FaultSpec("frame_decode", "frame_corrupt", at=2)) as inj:
+        try:
+            fold_stream(injected, payload, source="drill")
+            out["injected_typed"] = False
+        except MalformedFrameError:
+            out["injected_typed"] = True
+    out["injected_committed"] = injected.batches_ingested
+    out["injected_fired"] = len(inj.fired)
+
+    out["ok"] = (
+        out["clean_frames"] == 3 and baseline == 3
+        and out["disconnect_typed"] and out["torn_committed"] == 2
+        and out["corrupt_typed"] and out["corrupt_committed"] == 0
+        and out["injected_typed"] and out["injected_committed"] == 1
+    )
+    return out
 
 
 def _write_trace_artifact(tmpdir: str) -> Dict:
