@@ -1102,6 +1102,53 @@ def run_ingest_stage(rows: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# stage 2d: streaming knee (ISSUE 10 acceptance) — sessions/s with and
+# without cross-session fold coalescing on the PR 9 soak workload
+# ---------------------------------------------------------------------------
+
+
+def run_streaming_knee_stage() -> dict:
+    """Sessions/s at {100, 1000} sessions x {4096, 65536}-row micro-batches,
+    coalescing ON vs OFF, plus the bit-exact parity gate between the two
+    modes (tools/streaming_knee.py). Runs in a DETACHED subprocess so each
+    grid point's service/scheduler state starts cold and an interpreter
+    carrying this bench's device programs cannot flatter the numbers."""
+    import json as _json
+    import os
+    import subprocess
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.streaming_knee", "--stage-json"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=subprocess_timeout_s(),
+    )
+    if proc.returncode != 0 and not proc.stdout.strip():
+        raise RuntimeError(
+            f"streaming_knee subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}"
+        )
+    result = _json.loads(proc.stdout.strip().splitlines()[-1])
+    result["stage_seconds"] = time.perf_counter() - t0
+    if not result["parity"]["bit_exact"]:
+        log("PARITY MISMATCH streaming knee: coalesced != serial metrics")
+        sys.exit(1)
+    for p in result["points"]:
+        log(
+            f"[streaming_knee] {p['sessions']} sessions x {p['rows']} rows: "
+            f"serial {p['serial_sessions_per_s']:.0f}/s -> coalesced "
+            f"{p['coalesced_sessions_per_s']:.0f}/s ({p['speedup']:.1f}x, "
+            f"shed={p['shed']})"
+        )
+    log(
+        f"[streaming_knee] headline (1000x4096): "
+        f"{result['headline_sessions_per_s']:.0f} sessions/s "
+        f"({result['headline_speedup']:.1f}x serial), parity bit-exact"
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
 # stage 3: incremental/stateful partitions + sketch-state merge (BASELINE
 # config 4: partition states persisted, table metrics refreshed from merged
 # states WITHOUT rescanning data, anomaly check on the history)
@@ -1561,6 +1608,28 @@ def main() -> None:
         out["spill_rows_per_sec"] = round(spill["rows_per_sec"], 1)
         out["spill_peak_rss_gb"] = spill["peak_rss_gb"]
         checkpoint("spill", extra={"peak_rss_gb": spill["peak_rss_gb"]})
+
+    knee = staged(
+        "streaming_knee", run_streaming_knee_stage,
+        # four soak grid points x two modes in one detached child: give it
+        # the subprocess budget, not one in-process stage's
+        budget_s=subprocess_timeout_s() + 30,
+    )
+    if knee is not None:
+        out["streaming_knee_sessions_per_s"] = knee[
+            "headline_sessions_per_s"
+        ]
+        out["streaming_knee_speedup"] = knee["headline_speedup"]
+        checkpoint("streaming_knee", extra={
+            "points": [
+                {k: p[k] for k in (
+                    "sessions", "rows", "serial_sessions_per_s",
+                    "coalesced_sessions_per_s", "speedup", "shed",
+                )}
+                for p in knee["points"]
+            ],
+            "parity_bit_exact": knee["parity"]["bit_exact"],
+        })
 
     mesh_scaling = staged(
         "mesh_scaling", run_mesh_scaling_stage,

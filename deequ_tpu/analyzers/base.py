@@ -338,8 +338,22 @@ def merge_states_batched(analyzer: "Analyzer", states: Sequence[Any]) -> Optiona
             out, _ = jax.lax.scan(body, first, rest)
             return out
 
-        program = jax.jit(fold)
+        # donate the stacked input: it is a freshly built host stack (never
+        # re-read), so the fold's working buffers alias the transferred
+        # copy instead of duplicating it — one fewer state-sized copy per
+        # fold on the streaming plane's load->merge->persist cycle
+        program = jax.jit(fold, donate_argnums=0)
         _MERGE_FOLD_CACHE[key] = program
+        import warnings
+
+        with warnings.catch_warnings():
+            # first call traces+compiles: leaves whose scan carry changes
+            # layout report their donated buffer as unusable — expected
+            # (the donation exists for the large array leaves)
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return jax.device_get(program(stacked))
     return jax.device_get(program(stacked))
 
 

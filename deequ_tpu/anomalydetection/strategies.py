@@ -218,6 +218,134 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
             )
         return out
 
+    # -- batched scoring core (fleet watch: ROADMAP item 5) ------------------
+
+    def compute_stats_batch(
+        self, series_matrix, lengths=None, search_interval=(0, 2**63 - 1)
+    ):
+        """The scoring core vectorized over a SERIES axis: one array-shaped
+        call scores N metric series at once — the per-timestep recurrences
+        (incremental mean, Welford ``sn``, the anomaly-exclusion rollback)
+        run as elementwise numpy ops over all N series, so a fleet of
+        thousands of tenants' metric histories scores in O(T) vector steps
+        instead of N python loops. Per-element arithmetic is IDENTICAL to
+        the one-series :meth:`compute_stats_and_anomalies` (same formula,
+        same order, same IEEE ops), pinned by parity tests.
+
+        ``series_matrix``: float64 ``[N, T]``, ragged series padded on the
+        right (padding is ignored via ``lengths``). Returns ``(means,
+        std_devs, is_anomaly)`` each ``[N, T]``; entries past a series'
+        length are zeros/False."""
+        m = np.asarray(series_matrix, dtype=np.float64)
+        if m.ndim != 2:
+            raise ValueError("series_matrix must be [n_series, n_points]")
+        n, t = m.shape
+        lengths = (
+            np.full(n, t, dtype=np.int64) if lengths is None
+            else np.asarray(lengths, dtype=np.int64)
+        )
+        upper_factor = (
+            self.upper_deviation_factor
+            if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor
+            if self.lower_deviation_factor is not None else _POS_INF
+        )
+        search_start, search_end = search_interval
+        num_skip = lengths * self.ignore_start_percentage
+        means = np.zeros((n, t))
+        std_devs = np.zeros((n, t))
+        flags = np.zeros((n, t), dtype=bool)
+        current_mean = np.zeros(n)
+        sn = np.zeros(n)
+        for index in range(t):
+            active = index < lengths
+            value = np.where(active, m[:, index], 0.0)
+            last_mean = current_mean
+            last_sn = sn
+            if index == 0:
+                current_mean = value.copy()
+            else:
+                current_mean = last_mean + (value - last_mean) / (index + 1)
+            sn = last_sn + (value - last_mean) * (value - current_mean)
+            std_dev = np.sqrt(sn / (index + 1))
+            upper = current_mean + upper_factor * std_dev
+            lower = current_mean - lower_factor * std_dev
+            # points outside the search interval are never FLAGGED — and,
+            # exactly like the scalar path, never rolled back either
+            anomaly = active & ~(
+                (index < num_skip)
+                | (index < search_start)
+                | (index >= search_end)
+                | ((lower <= value) & (value <= upper))
+            )
+            if self.ignore_anomalies:
+                # the scalar path RESTORES the running stats for anomalous
+                # points (and records the restored mean with the
+                # pre-restore std) — replicated elementwise
+                current_mean = np.where(anomaly, last_mean, current_mean)
+                sn = np.where(anomaly, last_sn, sn)
+            inactive = ~active
+            current_mean = np.where(inactive, last_mean, current_mean)
+            sn = np.where(inactive, last_sn, sn)
+            means[:, index] = np.where(active, current_mean, 0.0)
+            std_devs[:, index] = np.where(active, std_dev, 0.0)
+            flags[:, index] = anomaly
+        return means, std_devs, flags
+
+    def detect_batch(self, series_list, search_interval):
+        """Batched :meth:`detect`: N series score through ONE
+        ``compute_stats_batch`` call; returns a list over series of the
+        same ``[(index, Anomaly), ...]`` the one-series path produces
+        (bounds, messages and indices identical — parity-pinned)."""
+        start, end = search_interval
+        if start > end:
+            raise ValueError("The start of the interval can't be larger than the end.")
+        series_list = [np.asarray(s, dtype=np.float64) for s in series_list]
+        if not series_list:
+            return []
+        lengths = np.array([len(s) for s in series_list], dtype=np.int64)
+        t = int(lengths.max()) if len(lengths) else 0
+        m = np.zeros((len(series_list), t))
+        for i, s in enumerate(series_list):
+            m[i, : len(s)] = s
+        means, std_devs, flags = self.compute_stats_batch(
+            m, lengths, search_interval
+        )
+        upper_factor = (
+            self.upper_deviation_factor
+            if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor
+            if self.lower_deviation_factor is not None else _POS_INF
+        )
+        out = []
+        for i, series in enumerate(series_list):
+            rows = []
+            for index in range(start, min(end, len(series))):
+                if not flags[i, index]:
+                    continue
+                mean = means[i, index]
+                std_dev = std_devs[i, index]
+                lower = mean - lower_factor * std_dev
+                upper = mean + upper_factor * std_dev
+                value = series[index]
+                rows.append(
+                    (
+                        index,
+                        Anomaly(
+                            value,
+                            1.0,
+                            f"[OnlineNormalStrategy]: Value {value} is not "
+                            f"in bounds [{lower}, {upper}].",
+                        ),
+                    )
+                )
+            out.append(rows)
+        return out
+
 
 @dataclass(frozen=True)
 class BatchNormalStrategy(AnomalyDetectionStrategy):

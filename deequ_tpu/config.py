@@ -124,6 +124,26 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Cross-session fold coalescing + tiny-delta host fast path (implemented in
+# deequ_tpu.service.coalesce; the env knobs are documented here with the
+# other operator-facing switches and re-exported below). All follow the
+# warn-and-fallback convention: an unparseable value warns once and keeps
+# the default.
+#
+# - DEEQU_TPU_COALESCE: "0" disables the whole coalescing plane — every
+#   streaming ingest takes exactly the pre-coalescing serial path (the
+#   true escape hatch; default on).
+# - DEEQU_TPU_COALESCE_MAX_WIDTH: max sessions stacked into one coalesced
+#   device launch (default 16; launches bucket their width to powers of
+#   two so the compiled-shape space stays log-bounded).
+# - DEEQU_TPU_FAST_PATH_MAX_ROWS: fixed row ceiling for the host fast
+#   path. Default -1 = route from the MEASURED per-analyzer-class
+#   crossover (host-kernel rates observed on every fast fold vs the
+#   device fixed cost observed on every coalesced launch); 0 forces every
+#   eligible fold onto the coalesced device path.
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -176,6 +196,11 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 from .ingest.prefetch import (  # noqa: E402,F401
     FEED_STALL_ENV,
     PREFETCH_DEPTH_ENV,
+)
+from .service.coalesce import (  # noqa: E402,F401
+    COALESCE_ENV,
+    COALESCE_MAX_WIDTH_ENV,
+    FAST_PATH_MAX_ROWS_ENV,
 )
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401

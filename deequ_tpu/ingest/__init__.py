@@ -24,9 +24,11 @@ from ..exceptions import (
 )
 from .arrow_stream import (
     CHECKSUM_HEADER,
+    BoundedReader,
     IngestReport,
     encode_ipc_stream,
     fold_stream,
+    fold_stream_reader,
     iter_frames,
 )
 from .columnar import as_dataset, payload_bytes
@@ -44,6 +46,7 @@ from .prefetch import (
 __all__ = [
     "as_dataset", "payload_bytes",
     "encode_ipc_stream", "iter_frames", "fold_stream", "IngestReport",
+    "fold_stream_reader", "BoundedReader",
     "CHECKSUM_HEADER", "INGEST_PREFIX", "IngestEndpoint",
     "PrefetchingBatchIterator", "prefetch_depth", "feed_stall_s",
     "PREFETCH_DEPTH_ENV", "DEFAULT_PREFETCH_DEPTH",

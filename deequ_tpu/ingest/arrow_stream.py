@@ -225,37 +225,186 @@ def fold_stream(
                     "deequ_service_ingest_malformed_total", **labels
                 )
                 raise
-        try:
-            for index, batch in iter_frames(
-                payload, source=source, complete=complete
-            ):
-                data = as_dataset(batch)
-                result = session.ingest(data, timeout=timeout)
-                report.frames += 1
-                report.rows += int(data.num_rows)
-                report.results.append(result)
-                metrics.inc("deequ_service_ingest_batches_total", **labels)
-                metrics.inc(
-                    "deequ_service_ingest_rows_total",
-                    float(data.num_rows), **labels,
-                )
-                sp.add_event(
-                    "frame_folded", frame=index, rows=int(data.num_rows)
-                )
-        except MalformedFrameError as exc:
-            record_failure(exc)
-            metrics.inc("deequ_service_ingest_malformed_total", **labels)
-            sp.add_event("malformed_frame", frame=report.frames)
-            raise
-        except FeedDisconnectError as exc:
-            record_failure(exc)
-            metrics.inc("deequ_service_ingest_disconnects_total", **labels)
-            sp.add_event("feed_disconnect", frames_folded=report.frames)
-            raise
+        _fold_frames(
+            session,
+            iter_frames(payload, source=source, complete=complete),
+            report, sp, timeout,
+        )
         # bytes count once per COMPLETED stream: a rejected payload's
         # bytes were never ingested, so MB/s on the plane stays honest
         metrics.inc(
             "deequ_service_ingest_bytes_total", float(payload.size), **labels
+        )
+    return report
+
+
+def _fold_frames(session, frames, report: IngestReport, sp, timeout) -> None:
+    """The shared per-frame fold loop (buffered and incremental paths):
+    one atomic micro-batch merge per decoded frame, typed failures
+    counted + flight-recorded, committed leading frames never rolled
+    back."""
+    from ..observability import record_failure
+
+    from .columnar import as_dataset
+
+    metrics = session.service.metrics
+    labels = {"tenant": session.tenant, "dataset": session.dataset}
+    try:
+        for index, batch in frames:
+            data = as_dataset(batch)
+            result = session.ingest(data, timeout=timeout)
+            report.frames += 1
+            report.rows += int(data.num_rows)
+            report.results.append(result)
+            metrics.inc_many([
+                ("deequ_service_ingest_batches_total", 1.0, labels),
+                ("deequ_service_ingest_rows_total",
+                 float(data.num_rows), labels),
+            ])
+            sp.add_event(
+                "frame_folded", frame=index, rows=int(data.num_rows)
+            )
+    except MalformedFrameError as exc:
+        record_failure(exc)
+        metrics.inc("deequ_service_ingest_malformed_total", **labels)
+        sp.add_event("malformed_frame", frame=report.frames)
+        raise
+    except FeedDisconnectError as exc:
+        record_failure(exc)
+        metrics.inc("deequ_service_ingest_disconnects_total", **labels)
+        sp.add_event("feed_disconnect", frames_folded=report.frames)
+        raise
+
+
+class BoundedReader:
+    """File-like view over a transport stream that reads at most ``limit``
+    bytes (an HTTP body must never be over-read: the bytes after it belong
+    to the next request) and counts what actually arrived. A short read —
+    the producer died — surfaces to the Arrow decoder as truncation, which
+    the typed contract maps to :class:`FeedDisconnectError`."""
+
+    def __init__(self, raw, limit: int):
+        self._raw = raw
+        self._remaining = int(limit)
+        self.bytes_read = 0
+        #: True once the transport delivered FEWER bytes than declared —
+        #: what tells a real disconnect (the producer died mid-body) from
+        #: a fully-delivered payload whose bytes are structurally bad
+        self.short = False
+
+    def read(self, n: int = -1) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        if n is None or n < 0 or n > self._remaining:
+            n = self._remaining
+        data = self._raw.read(n)
+        got = len(data)
+        self._remaining -= got
+        self.bytes_read += got
+        if got < n:
+            self.short = True
+            self._remaining = 0  # transport exhausted: everything after
+            # this is a short read, never a block on a dead socket
+        return data
+
+    def drain(self) -> None:
+        """Consume any unread remainder (trailing bytes after the Arrow
+        EOS marker) so a keep-alive connection stays framed."""
+        while self._remaining > 0:
+            if not self.read(min(self._remaining, 1 << 16)):
+                break
+
+    # the minimal file-object surface pyarrow's PythonFile wrapper probes
+    closed = False
+
+    def readable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        # the transport (HTTP rfile) outlives this view; never close it
+        pass
+
+
+def fold_stream_reader(
+    session,
+    reader: BoundedReader,
+    *,
+    source: str = "<stream>",
+    timeout: Optional[float] = None,
+) -> IngestReport:
+    """INCREMENTAL stream fold: decode Arrow IPC frames straight off a
+    transport reader and fold each as it arrives — a GB-scale stream
+    holds ONE frame in memory instead of buffering its whole body (the
+    unbuffered HTTP ingest path). Checksummed requests cannot ride this
+    path: the digest must verify over the complete payload BEFORE
+    anything folds, so the endpoint keeps those on the buffered
+    :func:`fold_stream` (the documented tripwire semantics, unchanged).
+
+    Failure contract mirrors ``fold_stream(complete=False)``: whole
+    leading frames fold and stay committed; a truncated tail — or a
+    transport error mid-read — raises typed :class:`FeedDisconnectError`;
+    structurally bad bytes with the stream still flowing raise
+    :class:`MalformedFrameError`."""
+    from ..observability import record_failure
+    from ..observability import trace as _trace
+
+    report = IngestReport(source=source)
+    metrics = session.service.metrics
+    labels = {"tenant": session.tenant, "dataset": session.dataset}
+
+    def frames():
+        from ..reliability.faults import fault_point
+
+        def classify(exc, index):
+            # truncation-shaped errors are a DISCONNECT only when the
+            # transport actually under-delivered; a fully-delivered body
+            # that still runs out of bytes is structurally malformed
+            if isinstance(exc, OSError) or (
+                _looks_truncated(exc) and reader.short
+            ):
+                return FeedDisconnectError(
+                    source, frames_decoded=index,
+                    bytes_read=reader.bytes_read, detail=str(exc),
+                )
+            return MalformedFrameError(source, str(exc), frame_index=index)
+
+        try:
+            arrow_reader = pa.ipc.open_stream(reader)
+        except Exception as exc:  # noqa: BLE001 - typed below
+            raise classify(exc, 0) from exc
+        index = 0
+        while True:
+            fault_point("frame_decode", tag=str(index))
+            try:
+                batch = arrow_reader.read_next_batch()
+            except StopIteration:
+                return
+            except MalformedFrameError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - typed below
+                raise classify(exc, index) from exc
+            yield index, batch
+            index += 1
+
+    with _trace.span(
+        "ingest_stream", kind="ingest", source=source,
+        tenant=session.tenant, dataset=session.dataset, incremental=True,
+    ) as sp:
+        metrics.inc("deequ_service_ingest_sessions_total", **labels)
+        _fold_frames(session, frames(), report, sp, timeout)
+        report.bytes = reader.bytes_read
+        metrics.inc(
+            "deequ_service_ingest_bytes_total",
+            float(reader.bytes_read), **labels,
         )
     return report
 

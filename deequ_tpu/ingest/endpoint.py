@@ -55,6 +55,38 @@ def _unquote(component: str) -> str:
     return unquote(component)
 
 
+
+def _typed_error_response(exc, metrics, labels, source) -> Tuple[int, dict]:
+    """One shared mapping from typed fold errors to HTTP responses —
+    the buffered and incremental paths must never diverge on status
+    semantics."""
+    if isinstance(exc, MalformedFrameError):
+        return 400, {"error": "malformed_frame", "detail": str(exc)}
+    if isinstance(exc, SchemaDriftError):
+        return 409, {"error": "schema_drift", "detail": str(exc)}
+    from ..service.errors import (
+        JobFailed,
+        JobTimeout,
+        ServiceClosed,
+        ServiceOverloaded,
+        SessionClosed,
+    )
+
+    if isinstance(exc, ServiceOverloaded):
+        metrics.inc("deequ_service_ingest_shed_total", **labels)
+        return 429, {"error": "overloaded", "detail": str(exc)}
+    if isinstance(exc, SessionClosed):
+        return 410, {"error": "session_closed"}
+    if isinstance(exc, ServiceClosed):
+        return 503, {"error": "service_closed"}
+    if isinstance(exc, JobTimeout):
+        return 504, {"error": "fold_timeout", "detail": str(exc)}
+    if isinstance(exc, JobFailed):
+        return 500, {"error": "fold_failed", "detail": str(exc)}
+    _logger.warning("ingest %s: unexpected failure", source, exc_info=True)
+    return 500, {"error": "internal", "detail": str(exc)}
+
+
 class IngestEndpoint:
     """Stateless request handler bound to one VerificationService."""
 
@@ -112,6 +144,17 @@ class IngestEndpoint:
         if declared <= 0:
             return 411, {"error": "length_required"}
         source = f"http:{tenant}/{dataset}"
+        checksum = headers.get(CHECKSUM_HEADER)
+        if checksum is None:
+            # INCREMENTAL decode: frames fold as they arrive off the
+            # socket — a GB-scale stream holds one frame in memory, not
+            # its whole body. Only possible WITHOUT a declared digest:
+            # a checksum must verify over the complete payload before
+            # anything folds (the tripwire contract), so checksummed
+            # requests keep the buffered path below.
+            return self._handle_incremental(
+                session, rfile, declared, source, metrics, labels
+            )
         try:
             body = rfile.read(declared)
         except OSError:
@@ -124,89 +167,75 @@ class IngestEndpoint:
             record_failure(FeedDisconnectError(source, detail="socket error"))
             return 400, {"error": "feed_disconnect", "received_bytes": 0,
                          "declared_bytes": declared}
-        checksum = headers.get(CHECKSUM_HEADER)
         if len(body) < declared:
-            if checksum is not None:
-                # the producer DECLARED a digest and a torn body can
-                # never verify it: folding unverified leading frames
-                # would bypass the exact tripwire the digest exists for
-                # (a flipped byte decodes silently in Arrow IPC), so
-                # nothing folds
-                metrics.inc(
-                    "deequ_service_ingest_disconnects_total", **labels
-                )
-                from ..observability import record_failure
+            # the producer DECLARED a digest and a torn body can never
+            # verify it: folding unverified leading frames would bypass
+            # the exact tripwire the digest exists for (a flipped byte
+            # decodes silently in Arrow IPC), so nothing folds. (Digest-
+            # free requests never reach here — they ride the incremental
+            # path, whose disconnect contract folds the whole leading
+            # frames.)
+            metrics.inc(
+                "deequ_service_ingest_disconnects_total", **labels
+            )
+            from ..observability import record_failure
 
-                record_failure(FeedDisconnectError(
-                    source, bytes_read=len(body),
-                    detail="checksummed stream torn; nothing folded",
-                ))
-                return 400, {
-                    "error": "feed_disconnect",
-                    "declared_bytes": declared,
-                    "received_bytes": len(body),
-                    "detail": "declared checksum cannot be verified on a "
-                              "torn body; nothing folded",
-                }
-            # no digest declared: the producer died mid-body — decode
-            # what arrived under the disconnect contract (whole leading
-            # frames fold, torn tail raises typed)
-            try:
-                fold_stream(
-                    session, body, complete=False, source=source,
-                    checksum=None,
-                )
-            except (FeedDisconnectError, MalformedFrameError):
-                pass
-            except Exception:  # noqa: BLE001 - the client is gone; the
-                # counters and flight record carry the outcome
-                _logger.warning(
-                    "ingest %s: error folding truncated body", source,
-                    exc_info=True,
-                )
-            else:
-                # every frame decoded despite the short read (length
-                # header lied high); still a disconnect for accounting
-                metrics.inc(
-                    "deequ_service_ingest_disconnects_total", **labels
-                )
+            record_failure(FeedDisconnectError(
+                source, bytes_read=len(body),
+                detail="checksummed stream torn; nothing folded",
+            ))
             return 400, {
                 "error": "feed_disconnect",
-                "declared_bytes": declared, "received_bytes": len(body),
+                "declared_bytes": declared,
+                "received_bytes": len(body),
+                "detail": "declared checksum cannot be verified on a "
+                          "torn body; nothing folded",
             }
         try:
             report = fold_stream(
                 session, body, checksum=checksum, complete=True,
                 source=source,
             )
-        except MalformedFrameError as exc:
-            return 400, {"error": "malformed_frame", "detail": str(exc)}
-        except SchemaDriftError as exc:
-            return 409, {"error": "schema_drift", "detail": str(exc)}
         except Exception as exc:  # noqa: BLE001 - typed service errors
-            from ..service.errors import (
-                JobFailed,
-                JobTimeout,
-                ServiceClosed,
-                ServiceOverloaded,
-                SessionClosed,
-            )
+            return _typed_error_response(exc, metrics, labels, source)
+        return 200, {"ok": True, **report.to_dict()}
 
-            if isinstance(exc, ServiceOverloaded):
-                metrics.inc("deequ_service_ingest_shed_total", **labels)
-                return 429, {"error": "overloaded", "detail": str(exc)}
-            if isinstance(exc, SessionClosed):
-                return 410, {"error": "session_closed"}
-            if isinstance(exc, ServiceClosed):
-                return 503, {"error": "service_closed"}
-            if isinstance(exc, JobTimeout):
-                return 504, {"error": "fold_timeout", "detail": str(exc)}
-            if isinstance(exc, JobFailed):
-                return 500, {"error": "fold_failed", "detail": str(exc)}
-            _logger.warning(
-                "ingest %s: unexpected failure", source, exc_info=True
-            )
-            return 500, {"error": "internal", "detail": str(exc)}
+    def _handle_incremental(
+        self, session, rfile, declared: int, source: str, metrics, labels
+    ) -> Tuple[int, dict]:
+        """Unbuffered body handling: Arrow frames decode straight off the
+        socket and fold one by one — memory holds one frame, not the
+        declared Content-Length. Torn-tail semantics are the documented
+        disconnect contract (complete leading frames stay committed, the
+        tail never folds, the tear is counted + flight-recorded by the
+        fold machinery)."""
+        from .arrow_stream import BoundedReader, fold_stream_reader
+
+        reader = BoundedReader(rfile, declared)
+        try:
+            report = fold_stream_reader(session, reader, source=source)
+        except FeedDisconnectError:
+            return 400, {
+                "error": "feed_disconnect",
+                "declared_bytes": declared,
+                "received_bytes": reader.bytes_read,
+            }
+        except Exception as exc:  # noqa: BLE001 - typed service errors
+            # drain the remainder so a keep-alive connection stays framed
+            # (the client may still be sending)
+            reader.drain()
+            return _typed_error_response(exc, metrics, labels, source)
+        reader.drain()
+        if reader.bytes_read < declared:
+            # every frame decoded but the body came up short (the length
+            # header lied high): still a disconnect for accounting —
+            # the buffered path's exact contract
+            metrics.inc("deequ_service_ingest_disconnects_total", **labels)
+            return 400, {
+                "error": "feed_disconnect",
+                "declared_bytes": declared,
+                "received_bytes": reader.bytes_read,
+            }
         return 200, {"ok": True, **report.to_dict()}
 
 

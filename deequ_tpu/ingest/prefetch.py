@@ -63,6 +63,19 @@ def feed_stall_s() -> float:
     return env_number(FEED_STALL_ENV, DEFAULT_FEED_STALL_S, float)
 
 
+def staging_depth(n_batches: int):
+    """The pipeline depth for a pass of ``n_batches``: ``None`` (the
+    configured depth) for multi-batch passes, ``0`` (inline, no feed
+    thread) for a single-batch pass. Double-buffering a one-batch fold
+    has nothing to overlap with, so the feed thread's spawn/teardown is
+    pure fixed cost — measurable on the streaming plane, where every
+    micro-batch fold is a one-batch pass (the ~50ms/fold knee diet). The
+    inline path keeps the ``prefetch`` fault site and identical ordering,
+    so semantics are unchanged — this is the documented "serial" mode
+    applied exactly where serial is optimal."""
+    return 0 if n_batches <= 1 else None
+
+
 #: queue sentinel kinds
 _ITEM, _DONE, _ERROR = 0, 1, 2
 

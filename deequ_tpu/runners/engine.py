@@ -113,6 +113,13 @@ class RunMonitor:
     #: surviving per-shard states salvaged into a canonical merge after a
     #: shard loss (what the elastic layer kept instead of recomputing)
     salvaged_states: int = 0
+    #: streaming folds served by the tiny-delta HOST fast path (delta state
+    #: computed with the host kernels, merged algebraically — no engine
+    #: pass, no device dispatch; service.coalesce routes these)
+    fast_path_folds: int = 0
+    #: streaming folds executed inside a cross-session COALESCED device
+    #: launch (stacked along a leading session axis, one vmapped program)
+    coalesced_folds: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -140,6 +147,38 @@ class RunMonitor:
         self.shard_losses = 0
         self.mesh_reshards = 0
         self.salvaged_states = 0
+        self.fast_path_folds = 0
+        self.coalesced_folds = 0
+
+    def merge_from(self, other: "RunMonitor") -> None:
+        """Absorb another monitor's counters and phase times (locked).
+        The coalescer records each fold's costs into a fold-local monitor
+        while the fold executes inside ANOTHER job's launch; the fold's
+        own job absorbs them here exactly once, so the export-plane
+        harvest attributes the work to the tenant that submitted it."""
+        with _MONITOR_LOCK:
+            for name in (
+                "passes", "batches", "device_updates", "program_compiles",
+                "device_failovers", "batch_bisections", "isolation_reruns",
+                "checkpoint_saves", "corrupt_quarantined", "stalls",
+                "device_stalls", "device_freq_sets",
+                "freq_overflow_fallbacks", "shard_losses", "mesh_reshards",
+                "salvaged_states", "fast_path_folds", "coalesced_folds",
+                "cost_probes",
+            ):
+                setattr(self, name, getattr(self, name) + getattr(other, name))
+            self.bundle_dispatch_seconds += other.bundle_dispatch_seconds
+            for phase, seconds in other.phase_seconds.items():
+                self.phase_seconds[phase] = (
+                    self.phase_seconds.get(phase, 0.0) + seconds
+                )
+            for key, seconds in other.cost_by_analyzer.items():
+                self.cost_by_analyzer[key] = (
+                    self.cost_by_analyzer.get(key, 0.0) + seconds
+                )
+            self.degraded.extend(other.degraded)
+            if other.placement is not None:
+                self.placement = other.placement
 
     def note_degraded(self, tag: str) -> None:
         with _MONITOR_LOCK:
@@ -311,6 +350,14 @@ class PackedScanProgram:
                 out_shardings=replicated(mesh),
                 donate_argnums=0,
             )
+        #: the raw traced bodies, kept so the cross-session COALESCED path
+        #: can lift the SAME update/unpack over a leading session axis
+        #: (jax.vmap) — one fused launch folds W sessions' batches; built
+        #: lazily on first coalesced use so ordinary runs pay nothing
+        self._fused_update_fn = fused_update
+        self._update_stacked = None
+        self._unpack_stacked_jit = None
+        self._init_stacked_jit = None
         self._unpack_jit = jax.jit(unpack)
         # pass-END unpack: the carry is dead afterwards, so donating it
         # lets the pass-through (aux) leaves alias instead of copy — a
@@ -394,6 +441,56 @@ class PackedScanProgram:
         checkpointed (host numpy) states. Lossless: every scalar leaf's
         dtype is ACC_DTYPE/COUNT_DTYPE, the packed vectors' own dtypes."""
         return self._pack(tuple(states))
+
+    # -- coalesced (stacked-over-sessions) entry points ----------------------
+    #
+    # The cross-session fold coalescer (service.coalesce) stacks W
+    # same-signature sessions' single padded batches along a leading axis
+    # and folds them as ONE device program: jax.vmap of the identical
+    # fused_update, so per-slot semantics — and the compiled reduction
+    # bits — match the serial dispatch exactly (pinned by the coalesce
+    # parity tests). jit re-specializes per W, and the coalescer buckets W
+    # to powers of two, so the compiled-shape space stays log-bounded.
+
+    def init_carry_stacked(self, width: int):
+        """W stacked identity carries, built on device in one dispatch."""
+        if self._init_stacked_jit is None:
+            pack, analyzers = self._pack, self.analyzers
+            self._init_stacked_jit = jax.jit(
+                lambda d: jax.vmap(
+                    lambda _: pack(tuple(a.init_state() for a in analyzers))
+                )(d)
+            )
+        return self._init_stacked_jit(jnp.zeros((width,), jnp.int32))
+
+    def call_with_slots_stacked(self, carry, slot_features):
+        """One coalesced dispatch: ``slot_features`` mirror
+        :meth:`call_with_slots` but every array carries a leading session
+        axis of the carry's width. The carry is DONATED (fold programs
+        never re-read it), so per-launch state copies disappear."""
+        if self._update_stacked is None:
+            self._update_stacked = jax.jit(
+                jax.vmap(self._fused_update_fn), donate_argnums=0
+            )
+        out = self._update_stacked(carry, slot_features)
+        self.executed = True
+        return out
+
+    def unpack_stacked_final(self, carry) -> Tuple:
+        """Stacked packed carry -> per-analyzer state pytrees whose leaves
+        keep the leading session axis (the caller splits per session after
+        ONE packed fetch). Donates the carry — launch-end only."""
+        if self._unpack_stacked_jit is None:
+            self._unpack_stacked_jit = jax.jit(
+                jax.vmap(self._unpack), donate_argnums=0
+            )
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return self._unpack_stacked_jit(carry)
 
     def _cache_size(self) -> int:
         return self._update._cache_size()
@@ -681,6 +778,68 @@ class BundledScanProgram:
 
     def _cache_size(self) -> int:
         return sum(p._cache_size() for p in self._distinct_programs())
+
+
+def fold_sessions_coalesced(
+    orchestrators: Sequence[BundledScanProgram],
+    features_list: Sequence[Dict[str, np.ndarray]],
+) -> List[Tuple]:
+    """Fold W same-signature sessions' single padded batches as ONE device
+    launch per signature bundle (the cross-session coalescer's device arm).
+
+    ``orchestrators[i]`` is session i's own battery orchestrator — its
+    ``_slot_keys`` gather ``features_list[i]`` under that battery's spec
+    keys; every battery in the group shares the template's per-position
+    scan signatures (the coalesce key guarantees it), so the gathered
+    arrays feed the TEMPLATE's bundle programs positionally, exactly like
+    a single-session bundled dispatch. The group pads to the next power of
+    two with duplicates of session 0 (bounding compiled widths to
+    log2(max_width) variants); pad outputs are discarded.
+
+    One vmapped dispatch per bundle + ONE packed state fetch for the whole
+    group — the per-session fixed cost this path exists to amortize.
+    Returns per REAL session a tuple of host state pytrees in battery
+    order. Mesh-free only (service streaming sessions coalesce; GSPMD
+    passes keep the serial path)."""
+    template = orchestrators[0]
+    if template.mesh is not None:
+        raise ValueError("coalesced folds are mesh-free by design")
+    n_real = len(features_list)
+    width = 1
+    while width < n_real:
+        width *= 2
+    gathered = [
+        [
+            tuple(tuple(feats[k] for k in slot) for slot in keys)
+            for keys in prog._slot_keys
+        ]
+        for prog, feats in zip(orchestrators, features_list)
+    ]
+    gathered.extend([gathered[0]] * (width - n_real))
+    stacked_states: List[Any] = [None] * len(template.analyzers)
+    for j, ((idxs, n_real_slots), bprog) in enumerate(
+        zip(template._bundles, template._programs)
+    ):
+        stacked_slots = tuple(
+            tuple(
+                np.stack([gathered[w][j][s][f] for w in range(width)])
+                for f in range(len(gathered[0][j][s]))
+            )
+            for s in range(len(template._slot_keys[j]))
+        )
+        carry = bprog.init_carry_stacked(width)
+        out = bprog.call_with_slots_stacked(carry, stacked_slots)
+        states = bprog.unpack_stacked_final(out)
+        for k in range(n_real_slots):
+            stacked_states[idxs[k]] = states[k]
+    fetched = _fetch_states_packed(tuple(stacked_states))
+    return [
+        tuple(
+            jax.tree_util.tree_map(lambda x, _w=w: x[_w], st)
+            for st in fetched
+        )
+        for w in range(n_real)
+    ]
 
 
 def _program_cache_key(analyzers: Tuple[ScanShareableAnalyzer, ...], mesh) -> Tuple:
@@ -1974,9 +2133,15 @@ class ScanEngine:
         # executes — transfer time hides under device compute instead of
         # serializing with it. DEEQU_TPU_PREFETCH_DEPTH=0 restores the
         # serial path (the measured baseline for the overlap numbers).
-        from ..ingest.prefetch import PrefetchingBatchIterator
+        # Single-batch passes (every streaming micro-batch fold) stage
+        # inline: there is nothing to overlap, and the feed-thread spawn
+        # was pure fixed cost on the micro-fold path.
+        from ..ingest.prefetch import PrefetchingBatchIterator, staging_depth
 
-        with PrefetchingBatchIterator(produce) as staged:
+        n_total_batches = max(1, -(-int(data.num_rows) // bs))
+        with PrefetchingBatchIterator(
+            produce, depth=staging_depth(n_total_batches)
+        ) as staged:
             for item in staged:
                 batch, features = item
                 monitor.bump("batches")

@@ -47,6 +47,7 @@ from .errors import (
     TransientFailure,
 )
 from ..exceptions import SchemaDriftError
+from .coalesce import CrossoverRouter, FoldCoalescer
 from .drift import DriftReport, SchemaContract
 from .metrics import MetricsExporter, ServiceMetrics
 from .placement import (
@@ -63,6 +64,7 @@ __all__ = [
     "StreamingSession",
     "PlacementRouter", "battery_signature", "shape_qualified_signature",
     "ServiceMetrics", "MetricsExporter",
+    "FoldCoalescer", "CrossoverRouter",
     "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
     "TransientFailure", "SessionClosed", "ServiceClosed",
     "SchemaContract", "DriftReport", "SchemaDriftError",
@@ -94,6 +96,12 @@ class VerificationService:
         )
         self.state_root = state_root
         self.mesh = mesh
+        from .coalesce import FoldCoalescer
+
+        #: cross-session fold coalescing + tiny-delta host fast path
+        #: (DEEQU_TPU_COALESCE=0 bypasses it per ingest, exactly
+        #: reproducing the serial path)
+        self.coalescer = FoldCoalescer(self)
         self._sessions: Dict[Tuple[str, str], StreamingSession] = {}
         self._sessions_lock = threading.Lock()
         self._exporter: Optional[MetricsExporter] = None

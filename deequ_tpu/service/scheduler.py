@@ -120,10 +120,11 @@ class _Job:
         "job_id", "fn", "tenant", "priority", "deadline_s", "deadline_abs",
         "submit_time", "max_retries", "retry_backoff_s", "retry_on",
         "signature", "handle", "attempts", "seq", "warm_fn", "serial_key",
-        "span",
+        "span", "defer_key",
     )
 
     def __init__(self, **kw):
+        self.defer_key = None
         for k, v in kw.items():
             setattr(self, k, v)
         self.attempts = 0
@@ -135,6 +136,22 @@ class _Job:
 #: ready-queue entries a worker inspects looking for an affinity match
 #: before falling back to the strict head (bounded so pickup stays O(1)-ish)
 _AFFINITY_SCAN = 8
+
+#: hard cap on entries the affinity loop may TOUCH, counting the same-key
+#: siblings it skips without scanning: a deep single-session backlog (one
+#: streaming session pipelining hundreds of folds) made the skip walk
+#: O(queue depth) per pickup — measured ~1ms/fold of pure scan CPU at 500
+#: queued folds (the streaming-knee scheduler diet)
+_AFFINITY_INSPECT = 32
+
+#: jobs a worker may claim in ONE queue-lock round-trip when the ready
+#: list is deep (the batched pickup of the streaming-knee scheduler diet):
+#: at thousands of micro-folds/s the per-job wake->lock->scan->unlock
+#: cycle — and the GIL handoffs it forces between eight workers — was a
+#: measurable slice of the fold fixed cost. Batching only engages under
+#: queue PRESSURE (depth >= 2x workers), so a sparse queue keeps strict
+#: one-at-a-time pickup and its latency profile.
+_PICK_BATCH = 8
 
 
 class JobScheduler:
@@ -174,6 +191,9 @@ class JobScheduler:
         #: it would let a later-submitted sibling overtake the retry and
         #: fold out of order
         self._running_keys: Dict[Any, _Job] = {}
+        #: coalesce keys under an ACTIVE drain: their jobs stay queued for
+        #: bulk absorption instead of being picked (see _eligible)
+        self._deferred: set = set()
         self.metrics.describe(
             "deequ_service_jobs_submitted_total", "Jobs accepted into the queue."
         )
@@ -274,6 +294,7 @@ class JobScheduler:
         warm_fn: Optional[Callable[[], None]] = None,
         serial_key: Optional[Any] = None,
         block_s: Optional[float] = None,
+        defer_key: Optional[Any] = None,
     ) -> JobHandle:
         """Admit one job, or shed it with :class:`ServiceOverloaded`.
 
@@ -319,7 +340,7 @@ class JobScheduler:
                 retry_backoff_s=float(retry_backoff_s),
                 retry_on=tuple(retry_on), signature=signature,
                 handle=handle, seq=seq, warm_fn=warm_fn,
-                serial_key=serial_key,
+                serial_key=serial_key, defer_key=defer_key,
             )
             # the trace root of the job's whole causal chain: admission,
             # every attempt/retry, placement, the engine passes it runs
@@ -346,11 +367,32 @@ class JobScheduler:
 
     def _eligible(self, job: _Job) -> bool:
         """May this job run now? Its serial key must be free — or owned by
-        the job itself (a promoted retry re-entering)."""
+        the job itself (a promoted retry re-entering) — and its defer key
+        (if any) must not be under an active coalesced drain: the drainer
+        is about to execute the job's fold and retire the job straight
+        from this queue (finish_absorbed), so a worker picking it up now
+        would only fight the drainer for the GIL to read a memo. The
+        drainer ALWAYS undefers on exit (finally + notify), so a deferred
+        job is picked normally the moment no drain covers it."""
+        if job.defer_key is not None and job.defer_key in self._deferred:
+            return False
         if job.serial_key is None:
             return True
         owner = self._running_keys.get(job.serial_key)
         return owner is None or owner is job
+
+    # -- coalescer coupling --------------------------------------------------
+
+    def defer_pickup(self, key: Any) -> None:
+        """A coalesced drain is active for ``key``: leave its jobs queued
+        for absorption (see ``_eligible``)."""
+        with self._cond:
+            self._deferred.add(key)
+
+    def resume_pickup(self, key: Any) -> None:
+        with self._cond:
+            self._deferred.discard(key)
+            self._cond.notify_all()  # deferred jobs are pickable again
 
     def _pick(self, worker_id: int) -> Optional[_Job]:
         """The best ready job this worker may run, or None when every ready
@@ -371,10 +413,16 @@ class JobScheduler:
         # must not reorder same-key siblings (FIFO per key).
         chosen = first
         scanned = 0
+        inspected = 0
         keys_seen: set = set()
         for j in range(first, len(self._ready)):
             entry = self._ready[j]
-            if entry[0] != self._ready[first][0] or scanned >= _AFFINITY_SCAN:
+            inspected += 1
+            if (
+                entry[0] != self._ready[first][0]
+                or scanned >= _AFFINITY_SCAN
+                or inspected > _AFFINITY_INSPECT
+            ):
                 break
             job_j = entry[2]
             if job_j.serial_key is not None:
@@ -384,7 +432,13 @@ class JobScheduler:
             if not self._eligible(job_j):
                 continue
             scanned += 1
-            if worker_id in self.router.preferred_workers(job_j.signature):
+            # signatureless jobs (fast-path streaming folds) have no
+            # device working set to be affine to — skip the router-lock
+            # round-trip the preferred_workers probe would cost per
+            # scanned entry (the streaming-knee scheduler diet)
+            if job_j.signature and worker_id in self.router.preferred_workers(
+                job_j.signature
+            ):
                 chosen = j
                 break
         job = self._ready.pop(chosen)[2]
@@ -395,12 +449,23 @@ class JobScheduler:
     def _worker_loop(self, worker_id: int) -> None:
         while True:
             with self._cond:
-                job = None
-                while job is None:
+                jobs: List[_Job] = []
+                while not jobs:
                     now = time.monotonic()
                     self._promote_due(now)
                     job = self._pick(worker_id)
                     if job is not None:
+                        jobs.append(job)
+                        # batched pickup: under queue pressure, claim more
+                        # eligible jobs in the SAME lock round-trip — the
+                        # worker then runs them back-to-back instead of
+                        # re-entering the wake/lock/scan cycle per job
+                        if len(self._ready) >= 2 * len(self._workers):
+                            while len(jobs) < _PICK_BATCH:
+                                extra = self._pick(worker_id)
+                                if extra is None:
+                                    break
+                                jobs.append(extra)
                         break
                     if self._closed and not self._delayed and not self._ready:
                         return
@@ -409,32 +474,44 @@ class JobScheduler:
                         timeout = max(self._delayed[0][0] - now, 0.0)
                     # a finishing job notifies, releasing its serial key
                     self._cond.wait(timeout)
-                self._active += 1
-                # the pickup freed a queue slot: wake one blocked
-                # backpressure submitter
-                self._space.notify()
-            retried = False
-            try:
-                retried = self._execute(job, worker_id)
-            except BaseException as exc:  # noqa: BLE001 - defense in depth:
-                # an error OUTSIDE the job body (router, metrics, harvest)
-                # must neither kill the worker thread nor leave the handle
-                # unresolved forever — "every job terminates with a result
-                # or a typed error" includes scheduler-infrastructure bugs
-                if not job.handle.done():
-                    self._finish(
-                        job, None, JobFailed(job.job_id, job.attempts, exc),
-                        outcome="failed",
-                    )
-            finally:
-                with self._cond:
-                    self._active -= 1
-                    # a retried job keeps OWNING its serial key through the
-                    # backoff: releasing it would let a later-submitted
-                    # sibling overtake the retry and execute out of order
-                    if job.serial_key is not None and not retried:
-                        self._running_keys.pop(job.serial_key, None)
-                    self._cond.notify_all()
+                self._active += len(jobs)
+                # the pickups freed queue slots: wake as many blocked
+                # backpressure submitters
+                self._space.notify(len(jobs))
+            for job in jobs:
+                self._run_one(job, worker_id)
+
+    def _run_one(self, job: _Job, worker_id: int) -> None:
+        retried = False
+        try:
+            retried = self._execute(job, worker_id)
+        except BaseException as exc:  # noqa: BLE001 - defense in depth:
+            # an error OUTSIDE the job body (router, metrics, harvest)
+            # must neither kill the worker thread nor leave the handle
+            # unresolved forever — "every job terminates with a result
+            # or a typed error" includes scheduler-infrastructure bugs
+            if not job.handle.done():
+                self._finish(
+                    job, None, JobFailed(job.job_id, job.attempts, exc),
+                    outcome="failed",
+                )
+        finally:
+            with self._cond:
+                self._active -= 1
+                # a retried job keeps OWNING its serial key through the
+                # backoff: releasing it would let a later-submitted
+                # sibling overtake the retry and execute out of order
+                if job.serial_key is not None and not retried:
+                    self._running_keys.pop(job.serial_key, None)
+                # ONE completion makes at most ONE blocked job newly
+                # eligible (the finished job's serial-key sibling), and
+                # this worker loops straight back into _pick itself —
+                # notify_all here was a thundering herd that woke every
+                # idle worker per job (measured on the streaming knee:
+                # 8 workers x thousands of folds/s of spurious
+                # wake-scan-sleep cycles under the queue lock).
+                # Shutdown wake-everyone stays notify_all in shutdown().
+                self._cond.notify()
 
     def _execute(self, job: _Job, worker_id: int) -> bool:
         """Run one job attempt under the job's trace context; returns True
@@ -517,47 +594,137 @@ class JobScheduler:
         self._finish(job, value, None, outcome="success")
         return False
 
+    def finish_absorbed(self, absorbed) -> None:
+        """Resolve jobs whose WORK was already executed by a coalesced
+        drain while they sat in the ready queue: each is removed from the
+        queue (one lock round-trip for the whole batch) and finished with
+        its fold's outcome — it never occupies a worker. This is the
+        batched-harvest half of the streaming-knee scheduler diet: a
+        512-fold drain retires up to 511 sibling jobs without 511
+        wake/pick/execute/finish cycles.
+
+        ``absorbed``: iterable of ``(handle, value, error, tenant,
+        monitor, signature, worker_id)``. Entries whose job was already
+        picked up (or retried) are skipped — the running job consumes the
+        fold's memoized outcome itself. Only deadline-FREE jobs are ever
+        absorbed (the coalescer never drains deadline'd folds), so the
+        queued-past-deadline contract is untouched."""
+        entries = list(absorbed)
+        if not entries:
+            return
+        handles = {e[0] for e in entries}
+        found: Dict[Any, _Job] = {}
+        with self._cond:
+            kept = []
+            for entry in self._ready:
+                job = entry[2]
+                if job.handle in handles:
+                    found[job.handle] = job
+                else:
+                    kept.append(entry)
+            if found:
+                self._ready = kept
+                # the absorptions freed queue slots: wake as many blocked
+                # backpressure submitters
+                self._space.notify(len(found))
+        updates: list = []
+        for handle, value, error, tenant, monitor, signature, worker_id in entries:
+            job = found.get(handle)
+            if job is None:
+                continue
+            job.attempts = 1  # the drain WAS the attempt
+            job.span.add_event("absorbed_by_drain")
+            self._harvest_monitor(
+                tenant, monitor, job.handle, signature, updates=updates
+            )
+            if error is None:
+                self.router.note_ran(signature, worker_id, monitor.placement)
+                self._finish(job, value, None, outcome="success")
+            elif isinstance(error, ServiceError) and not isinstance(
+                error, TransientFailure
+            ):
+                self._finish(job, None, error, outcome="failed")
+            else:
+                self._finish(
+                    job, None, JobFailed(job.job_id, 1, error),
+                    outcome="failed",
+                )
+        if updates:
+            self.metrics.inc_many(updates)
+
     def _harvest(self, job: _Job, ctx: JobContext) -> None:
-        self.metrics.observe_phases(ctx.monitor.phase_seconds)
-        for phase, seconds in ctx.monitor.phase_seconds.items():
-            job.handle.phase_seconds[phase] = (
-                job.handle.phase_seconds.get(phase, 0.0) + seconds
+        self._harvest_monitor(
+            job.tenant, ctx.monitor, job.handle, job.signature
+        )
+
+    def _harvest_monitor(
+        self, tenant: str, monitor: RunMonitor, handle: JobHandle, signature,
+        updates: Optional[list] = None,
+    ) -> None:
+        # ONE batched metrics-lock round-trip for the whole harvest: at
+        # thousands of folds/s the previous per-series inc() calls (phase
+        # map + cost table + up to 8 reliability series, each taking the
+        # export-plane lock) were a measurable slice of the per-fold fixed
+        # cost the coalescing plane exists to kill. A caller-provided
+        # ``updates`` list defers the flush — finish_absorbed batches a
+        # whole drain's harvests into ONE round-trip.
+        flush = updates is None
+        if flush:
+            updates = []
+        updates += [
+            ("deequ_service_phase_seconds_total", seconds, {"phase": phase})
+            for phase, seconds in monitor.phase_seconds.items()
+        ]
+        for phase, seconds in monitor.phase_seconds.items():
+            handle.phase_seconds[phase] = (
+                handle.phase_seconds.get(phase, 0.0) + seconds
             )
-        for analyzer, seconds in dict(ctx.monitor.cost_by_analyzer).items():
-            self.metrics.inc(
-                "deequ_service_analyzer_cost_seconds_total", seconds,
-                analyzer=analyzer, tenant=job.tenant,
-            )
-        monitor = ctx.monitor
+        tenant_label = {"tenant": tenant}
+        updates.extend(
+            ("deequ_service_analyzer_cost_seconds_total", seconds,
+             {"analyzer": analyzer, "tenant": tenant})
+            for analyzer, seconds in dict(monitor.cost_by_analyzer).items()
+        )
         if monitor.stalls:
             # every stall surfaces on the export plane; only DEVICE-tier
             # stalls feed probation below (pinning a battery to the host
             # tier because the HOST hung would probation it onto the sick
             # tier)
-            self.metrics.inc(
-                "deequ_service_scan_stalls_total",
-                float(monitor.stalls), tenant=job.tenant,
+            updates.append(
+                ("deequ_service_scan_stalls_total", float(monitor.stalls),
+                 tenant_label)
             )
-        if monitor.shard_losses or monitor.mesh_reshards:
-            # mesh elasticity on the export plane: every shard loss, every
-            # re-shard (in-pass or pass-level) and every salvaged state is
-            # countable per tenant — the acceptance signal that a loss was
-            # absorbed rather than fatal
-            if monitor.shard_losses:
-                self.metrics.inc(
-                    "deequ_service_shard_losses_total",
-                    float(monitor.shard_losses), tenant=job.tenant,
-                )
-            if monitor.mesh_reshards:
-                self.metrics.inc(
-                    "deequ_service_mesh_reshards_total",
-                    float(monitor.mesh_reshards), tenant=job.tenant,
-                )
-            if monitor.salvaged_states:
-                self.metrics.inc(
-                    "deequ_service_salvaged_states_total",
-                    float(monitor.salvaged_states), tenant=job.tenant,
-                )
+        # mesh elasticity on the export plane: every shard loss, every
+        # re-shard (in-pass or pass-level) and every salvaged state is
+        # countable per tenant — the acceptance signal that a loss was
+        # absorbed rather than fatal
+        if monitor.shard_losses:
+            updates.append(
+                ("deequ_service_shard_losses_total",
+                 float(monitor.shard_losses), tenant_label)
+            )
+        if monitor.mesh_reshards:
+            updates.append(
+                ("deequ_service_mesh_reshards_total",
+                 float(monitor.mesh_reshards), tenant_label)
+            )
+        if monitor.salvaged_states:
+            updates.append(
+                ("deequ_service_salvaged_states_total",
+                 float(monitor.salvaged_states), tenant_label)
+            )
+        if monitor.isolation_reruns:
+            updates.append(
+                ("deequ_service_isolation_reruns_total",
+                 float(monitor.isolation_reruns), tenant_label)
+            )
+        if monitor.degraded:
+            updates.append(
+                ("deequ_service_degraded_analyzers_total",
+                 float(len(monitor.degraded)), tenant_label)
+            )
+        if flush:
+            self.metrics.inc_many(updates)
         if (
             monitor.device_failovers
             or monitor.batch_bisections
@@ -568,17 +735,7 @@ class JobScheduler:
             # teach the router to keep the battery on the host tier for a
             # probation window (also fires on failed attempts, so a retry
             # lands on the healthy tier immediately)
-            self.router.note_device_failure(job.signature)
-        if monitor.isolation_reruns:
-            self.metrics.inc(
-                "deequ_service_isolation_reruns_total",
-                float(monitor.isolation_reruns), tenant=job.tenant,
-            )
-        if monitor.degraded:
-            self.metrics.inc(
-                "deequ_service_degraded_analyzers_total",
-                float(len(monitor.degraded)), tenant=job.tenant,
-            )
+            self.router.note_device_failure(signature)
 
     def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:
         from ..exceptions import ScanStallError

@@ -127,6 +127,13 @@ class StreamingSession:
             )
         self.drift_policy = drift_policy
         self._serial = threading.Lock()  # orders load-merge-persist cycles
+        #: held across [coalescer enqueue -> scheduler submit] so a
+        #: session's pending-fold queue order always equals its job
+        #: submission order (the FIFO the coalescer's drains rely on);
+        #: never held during a fold
+        self._submit_order = threading.Lock()
+        #: coalesce eligibility plans keyed by schema fingerprint
+        self._plans: dict = {}
         self._closed = False
         self._schema = None
         #: the schema promise captured from the FIRST folded batch; every
@@ -196,33 +203,122 @@ class StreamingSession:
         data = as_dataset(data)
         done: dict = {}  # per-job memo: a retried job must never re-fold
         bs = _session_batch_size(int(data.num_rows), self.batch_size)
-
-        def fold(ctx: JobContext):
-            return self._fold_batch(ctx, data, done, bs)
+        effective_deadline = (
+            deadline_s if deadline_s is not None else self.deadline_s
+        )
 
         from .placement import make_warm_fn, shape_qualified_signature
 
-        warm = make_warm_fn(
-            self.service.router, self._analyzers, self.service.mesh, data, bs
-        )
-        handle = self.service.scheduler.submit(
-            fold,
-            tenant=self.tenant,
-            priority=self.priority,
-            deadline_s=deadline_s if deadline_s is not None else self.deadline_s,
-            max_retries=self.max_retries,
-            # per-shape warmth: the bucketed batch size is part of the key
-            signature=shape_qualified_signature(self._analyzers, bs),
-            job_id=f"{self.tenant}/{self.dataset}#{next(self._submit_seq)}",
-            warm_fn=warm,
-            # scheduler-level serialization: one fold at a time per session,
-            # in submission order — pipelined ingests occupy ONE worker and
-            # cannot fold out of order (per-batch anomaly attribution)
-            serial_key=(self.tenant, self.dataset),
-            # backpressure: wait for queue space up to block_s before the
-            # typed shed (per-call override, else the session default)
-            block_s=block_s if block_s is not None else self.admission_block_s,
-        )
+        # cross-session coalescing (service.coalesce): an eligible
+        # micro-batch fold routes through the coalescer — the tiny-delta
+        # host fast path, or a signature-keyed group that a worker stacks
+        # into ONE device launch. prepare() returning None (knob off,
+        # ineligible battery, multi-batch, mesh) keeps the exact
+        # pre-coalescing path below. The submit-order lock makes [enqueue
+        # -> submit] atomic per session, so the coalescer's per-session
+        # FIFO equals the scheduler's serial-key FIFO; deadline'd folds
+        # are never cross-drained (drainable=False), keeping JobTimeout
+        # semantics with the fold's own job.
+        coalescer = getattr(self.service, "coalescer", None)
+        pending = None
+        barrier = False
+        with self._submit_order:
+            if coalescer is not None:
+                pending = coalescer.prepare(
+                    self, data, bs, drainable=effective_deadline is None
+                )
+            if pending is not None:
+                runner = coalescer.run_fold
+
+                def fold(ctx: JobContext, _p=pending):
+                    return runner(ctx, _p)
+
+                if pending.route == "fast":
+                    # no device program to warm, no affinity to track —
+                    # the empty signature also short-circuits the
+                    # scheduler's affinity scan (one less lock round-trip
+                    # per pickup on the hot path)
+                    signature, warm = (), None
+                else:
+                    signature = shape_qualified_signature(self._analyzers, bs)
+                    warm = make_warm_fn(
+                        self.service.router, self._analyzers,
+                        self.service.mesh, data, bs,
+                    )
+            else:
+                # a SERIAL-path fold raises the session's coalescer
+                # barrier: no later drainable fold may be cross-drained
+                # ahead of it (per-session FIFO spans both paths); the
+                # fold body clears it once, on its first attempt
+                barrier = (
+                    coalescer.note_serial_fold(self)
+                    if coalescer is not None else False
+                )
+                skey = (self.tenant, self.dataset)
+
+                def fold(ctx: JobContext):
+                    try:
+                        return self._fold_batch(ctx, data, done, bs)
+                    finally:
+                        if barrier and "barrier_cleared" not in done:
+                            done["barrier_cleared"] = True
+                            coalescer.clear_serial_barrier(skey)
+
+                signature = shape_qualified_signature(self._analyzers, bs)
+                warm = make_warm_fn(
+                    self.service.router, self._analyzers, self.service.mesh,
+                    data, bs,
+                )
+            try:
+                handle = self.service.scheduler.submit(
+                    fold,
+                    tenant=self.tenant,
+                    priority=self.priority,
+                    deadline_s=effective_deadline,
+                    max_retries=self.max_retries,
+                    # per-shape warmth: the bucketed batch size is part of
+                    # the key
+                    signature=signature,
+                    job_id=(
+                        f"{self.tenant}/{self.dataset}"
+                        f"#{next(self._submit_seq)}"
+                    ),
+                    warm_fn=warm,
+                    # scheduler-level serialization: one fold at a time per
+                    # session, in submission order — pipelined ingests
+                    # occupy ONE worker and cannot fold out of order
+                    # (per-batch anomaly attribution)
+                    serial_key=(self.tenant, self.dataset),
+                    # backpressure: wait for queue space up to block_s
+                    # before the typed shed (per-call override, else the
+                    # session default)
+                    block_s=(
+                        block_s if block_s is not None
+                        else self.admission_block_s
+                    ),
+                    # while a drain sweeps this fold's coalesce key, the
+                    # job stays queued for bulk absorption instead of
+                    # being picked (scheduler._eligible)
+                    defer_key=(
+                        pending.key
+                        if pending is not None and pending.drainable
+                        else None
+                    ),
+                )
+            except BaseException:
+                if pending is not None:
+                    # shed/closed before admission: the fold must not
+                    # linger claimable in the coalescer
+                    coalescer.abandon(pending)
+                elif barrier:
+                    # a shed serial fold never runs its body: release the
+                    # barrier it raised
+                    coalescer.clear_serial_barrier(
+                        (self.tenant, self.dataset)
+                    )
+                raise
+            if pending is not None:
+                coalescer.mark_submitted(pending, handle, signature)
         if wait:
             from .errors import JobFailed, JobTimeout
 
@@ -264,18 +360,7 @@ class StreamingSession:
         with self._serial:
             if self._closed:
                 raise SessionClosed(self.tenant, self.dataset)
-            pending_contract = None
-            if self._contract is None:
-                # the contract COMMITS only after this batch's fold
-                # succeeds: a first batch whose fold raises never folded,
-                # so its schema must not pin the session (a wrong-schema
-                # first batch would otherwise reject every corrected
-                # batch after it until an operator deleted the contract)
-                from .drift import SchemaContract
-
-                pending_contract = SchemaContract.capture(data)
-            else:
-                data = self._guard_schema(data)
+            data, pending_contract, _degraded = self._pre_fold(data)
             result = VerificationSuite.do_verification_run(
                 data,
                 self.checks,
@@ -287,35 +372,73 @@ class StreamingSession:
                 sharding=self.service.mesh,
                 placement=ctx.placement,
             )
-            done["result"] = result
-            if pending_contract is not None:
-                self._contract = pending_contract
-                self._store_contract()
-            self._schema = self._schema or data.schema
-            self.batches_ingested += 1
-            self.rows_ingested += int(data.num_rows)
-            from ..ingest.columnar import payload_bytes
-
-            self.bytes_ingested += payload_bytes(data)
-            self.results.append(result)
-            metrics = self.service.metrics
-            metrics.inc(
-                "deequ_service_stream_batches_total",
-                tenant=self.tenant, dataset=self.dataset,
-            )
-            metrics.inc(
-                "deequ_service_stream_rows_total", float(data.num_rows),
-                tenant=self.tenant, dataset=self.dataset,
-            )
-            if result.status != CheckStatus.SUCCESS:
-                # the mid-stream anomaly signal: a failing merge is visible
-                # on the export plane the moment it happens
-                metrics.inc(
-                    "deequ_service_stream_check_failures_total",
-                    tenant=self.tenant, dataset=self.dataset,
-                    status=result.status.value,
-                )
+            self._commit_fold(result, data, pending_contract, done)
         return self._notify(done)
+
+    def _pre_fold(self, data: Dataset):
+        """Under ``self._serial``: the schema-contract half of a fold.
+        Returns ``(data_to_fold, pending_contract, guard_degraded)`` —
+        ``pending_contract`` is non-None only for the session's FIRST fold
+        (committed by `_commit_fold` after the fold succeeds), and
+        ``guard_degraded`` flags a degrade-policy guard outcome (columns
+        excluded) that only the full runner's per-analyzer degradation can
+        honor. Raises typed ``SchemaDriftError`` with states untouched."""
+        if self._contract is None:
+            # the contract COMMITS only after this batch's fold
+            # succeeds: a first batch whose fold raises never folded,
+            # so its schema must not pin the session (a wrong-schema
+            # first batch would otherwise reject every corrected
+            # batch after it until an operator deleted the contract)
+            from .drift import SchemaContract
+
+            return data, SchemaContract.capture(data), False
+        degraded_before = self.drift_degraded_batches
+        data = self._guard_schema(data)
+        return data, None, self.drift_degraded_batches != degraded_before
+
+    def _commit_fold(self, result, data: Dataset, pending_contract, done: dict):
+        """Under ``self._serial``: one successful fold's bookkeeping —
+        contract commit, counters, bounded results ring, export-plane
+        series. Shared verbatim between the serial path and the
+        coalescer's fast/device folds so the two can never drift."""
+        done["result"] = result
+        if pending_contract is not None:
+            self._contract = pending_contract
+            self._store_contract()
+        self._schema = self._schema or data.schema
+        self.batches_ingested += 1
+        self.rows_ingested += int(data.num_rows)
+        from ..ingest.columnar import payload_bytes
+
+        self.bytes_ingested += payload_bytes(data)
+        self.results.append(result)
+        metrics = self.service.metrics
+        metrics.inc_many([
+            ("deequ_service_stream_batches_total", 1.0,
+             {"tenant": self.tenant, "dataset": self.dataset}),
+            ("deequ_service_stream_rows_total", float(data.num_rows),
+             {"tenant": self.tenant, "dataset": self.dataset}),
+        ])
+        if result.status != CheckStatus.SUCCESS:
+            # the mid-stream anomaly signal: a failing merge is visible
+            # on the export plane the moment it happens
+            metrics.inc(
+                "deequ_service_stream_check_failures_total",
+                tenant=self.tenant, dataset=self.dataset,
+                status=result.status.value,
+            )
+
+    def _coalesce_plan(self, data: Dataset):
+        """The session's coalesce eligibility plan for this schema
+        (``None`` = serial path). Per-session memo over the coalescer's
+        SHARED plan cache — same-battery fleets build one plan total."""
+        schema = data.schema
+        fp = tuple((c.name, c.kind) for c in schema.columns)
+        if fp not in self._plans:
+            self._plans[fp] = self.service.coalescer.plan_for(
+                self._analyzers, schema, fp
+            )
+        return self._plans[fp]
 
     def _guard_schema(self, data: Dataset) -> Dataset:
         """The drift guard, run under the serial lock BEFORE anything

@@ -212,3 +212,65 @@ class TestFiniteSentinels:
         s = BatchNormalStrategy(upper_deviation_factor=None)
         found = s.detect([1.0, 1.0, 1.0, 1.0, -100.0], (4, 5))
         assert [i for i, _ in found] == [4]
+
+
+class TestBatchedOnlineNormal:
+    """The array-shaped batched scoring core (ROADMAP item 5, first step):
+    N series score in ONE vectorized call, element-for-element identical
+    to the one-series path."""
+
+    def _series_fleet(self, n=32, seed=9):
+        rng = np.random.default_rng(seed)
+        fleet = []
+        for _ in range(n):
+            s = rng.normal(10, 2, int(rng.integers(15, 90))).tolist()
+            for j in rng.integers(4, len(s), 3):
+                s[int(j)] += float(rng.choice([-1, 1])) * 40
+            fleet.append(s)
+        return fleet
+
+    def test_batch_matches_single_series_exactly(self):
+        for strat in (
+            OnlineNormalStrategy(),
+            OnlineNormalStrategy(ignore_anomalies=False),
+            OnlineNormalStrategy(
+                lower_deviation_factor=None, upper_deviation_factor=2.5,
+                ignore_start_percentage=0.2,
+            ),
+        ):
+            fleet = self._series_fleet()
+            for interval in [(0, 2 ** 63 - 1), (5, 40), (10, 20)]:
+                batched = strat.detect_batch(fleet, interval)
+                assert len(batched) == len(fleet)
+                for series, got in zip(fleet, batched):
+                    want = strat.detect(series, interval)
+                    assert [i for i, _ in got] == [i for i, _ in want]
+                    for (_, ga), (_, wa) in zip(got, want):
+                        assert float(ga.value) == float(wa.value)
+                        assert ga.detail == wa.detail
+
+    def test_batch_stats_core_is_vectorized_shape(self):
+        strat = OnlineNormalStrategy()
+        m = np.vstack([np.ones(20), np.arange(20, dtype=float)])
+        means, stds, flags = strat.compute_stats_batch(m)
+        assert means.shape == stds.shape == flags.shape == (2, 20)
+        # a constant series is never anomalous
+        assert not flags[0].any()
+
+    def test_batch_ragged_lengths_ignore_padding(self):
+        strat = OnlineNormalStrategy(ignore_start_percentage=0.0)
+        short = [10.0, 10.1, 9.9, 10.0, 50.0]
+        long = [10.0] * 40 + [90.0] + [10.0] * 10
+        batched = strat.detect_batch([short, long], (0, 2 ** 63 - 1))
+        assert [i for i, _ in batched[0]] == [
+            i for i, _ in strat.detect(short, (0, 2 ** 63 - 1))
+        ]
+        assert [i for i, _ in batched[1]] == [
+            i for i, _ in strat.detect(long, (0, 2 ** 63 - 1))
+        ]
+
+    def test_batch_empty_and_validation(self):
+        strat = OnlineNormalStrategy()
+        assert strat.detect_batch([], (0, 10)) == []
+        with pytest.raises(ValueError):
+            strat.detect_batch([[1.0]], (5, 2))

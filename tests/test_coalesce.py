@@ -1,0 +1,843 @@
+"""Cross-session fold coalescing (service.coalesce): routing, parity,
+FIFO, fault isolation, scheduler-diet invariants.
+
+Parity contract pinned here:
+
+- the tiny-delta HOST fast path is BIT-EXACT against the serial path on
+  both tiers (its states are identity-merge transparent and its merge is
+  the numpy twin of the compiled one);
+- the coalesced DEVICE launch (vmap of the identical fused update) is
+  bit-exact for the algebraic accumulator classes; KLL sketches stay
+  within their documented rank-error envelope (vmap lowers the sketch's
+  sort/compaction differently — both results are valid sketches of the
+  same data) and Correlation agrees to ~1 ulp (batched co-moment
+  reduction order).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import deequ_tpu  # noqa: F401 - x64 config
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Correlation,
+    KLLParameters,
+    KLLSketch,
+    Mean,
+    Size,
+    StandardDeviation,
+    Uniqueness,
+)
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus
+from deequ_tpu.service import VerificationService
+from deequ_tpu.service.coalesce import (
+    COALESCE_ENV,
+    FAST_PATH_MAX_ROWS_ENV,
+    CrossoverRouter,
+    build_fold_plan,
+    coalesce_enabled,
+)
+
+pytestmark = pytest.mark.coalesce
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in (COALESCE_ENV, FAST_PATH_MAX_ROWS_ENV,
+                "DEEQU_TPU_COALESCE_MAX_WIDTH", "DEEQU_TPU_PLACEMENT"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def _table(rows: int, seed: int) -> "pa.Table":
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "x": pa.array(rng.normal(size=rows),
+                      mask=rng.random(rows) < 0.05),
+        "y": rng.normal(10.0, 2.0, size=rows),
+        "k": rng.integers(0, 500, size=rows),
+    })
+
+
+def _checks():
+    return [
+        Check(CheckLevel.ERROR, "battery")
+        .has_size(lambda n: n > 0)
+        .is_complete("y")
+        .has_completeness("x", lambda c: c > 0.5)
+        .has_mean("y", lambda m: 5 < m < 15)
+        .has_sum("y", lambda s: s > 0)
+        .has_min("y", lambda m: True)
+        .has_max("y", lambda m: True),
+    ]
+
+
+def _metrics_map(session):
+    cum = session.current()
+    return {
+        repr(a): m.value.get()
+        for a, m in cum.metrics.items()
+        if m.value.is_success
+    }
+
+
+def _run_stream(
+    coalesce: str,
+    *,
+    placement=None,
+    required=(),
+    checks=None,
+    sessions=1,
+    batches=3,
+    rows=4096,
+    workers=2,
+    pipelined=False,
+    monkeypatch=None,
+    force_device=False,
+):
+    monkeypatch.setenv(COALESCE_ENV, coalesce)
+    if force_device:
+        monkeypatch.setenv(FAST_PATH_MAX_ROWS_ENV, "0")
+    if placement:
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+    svc = VerificationService(workers=workers, background_warm=False)
+    try:
+        sess = [
+            svc.session(f"t{i}", "d", checks or _checks(),
+                        required_analyzers=list(required))
+            for i in range(sessions)
+        ]
+        for b in range(batches):
+            handles = []
+            for i, s in enumerate(sess):
+                data = _table(rows, seed=1000 + 97 * i + b)
+                if pipelined:
+                    handles.append(s.ingest(data, wait=False))
+                else:
+                    s.ingest(data)
+            for h in handles:
+                h.result(180)
+        outs = [_metrics_map(s) for s in sess]
+        counters = svc.metrics.json_snapshot()["counters"]
+        return outs, counters
+    finally:
+        svc.close()
+
+
+class TestRouting:
+    def test_escape_hatch_reproduces_serial_path(self, monkeypatch):
+        """DEEQU_TPU_COALESCE=0: no routing counters, no fast folds, no
+        coalesced launches — the exact pre-coalescing path."""
+        outs_off, counters = _run_stream("0", monkeypatch=monkeypatch)
+        assert "deequ_service_fold_route_total" not in counters
+        assert "deequ_service_fast_path_folds_total" not in counters
+        assert "deequ_service_coalesced_folds_total" not in counters
+        assert outs_off[0]  # the folds themselves completed
+
+    def test_fast_route_for_transparent_battery(self, monkeypatch):
+        outs, counters = _run_stream("1", monkeypatch=monkeypatch,
+                                     batches=2)
+        fast = counters.get("deequ_service_fast_path_folds_total", {})
+        total = sum(fast.values()) if isinstance(fast, dict) else fast
+        assert total == 2
+        routes = counters["deequ_service_fold_route_total"]
+        assert routes.get("route=fast") == 2
+
+    def test_sketch_battery_routes_device(self, monkeypatch):
+        """KLL overrides ingest_partial and its state is not
+        identity-merge transparent -> the crossover router must send the
+        battery to the coalesced device path, never the host fast path."""
+        _, counters = _run_stream(
+            "1", monkeypatch=monkeypatch, batches=2,
+            required=[KLLSketch("y", KLLParameters(256, 0.64, 10))],
+        )
+        routes = counters["deequ_service_fold_route_total"]
+        assert routes.get("route=fast") is None
+        assert routes.get("route=device") == 2
+        co = counters.get("deequ_service_coalesced_folds_total", 0)
+        assert co == 2
+
+    def test_grouping_battery_routes_serial(self, monkeypatch):
+        checks = [Check(CheckLevel.ERROR, "g").has_uniqueness(
+            ["k"], lambda u: True)]
+        _, counters = _run_stream(
+            "1", monkeypatch=monkeypatch, checks=checks, batches=1,
+        )
+        routes = counters["deequ_service_fold_route_total"]
+        assert routes.get("route=serial") == 1
+
+    def test_multi_batch_fold_keeps_engine_path(self, monkeypatch):
+        """A micro-batch larger than the bucket cap streams through the
+        ordinary engine (multi-batch pass) — never coalesced."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            s = svc.session("t", "big", _checks(), batch_size=2048)
+            s.ingest(_table(8192, seed=3))
+            counters = svc.metrics.json_snapshot()["counters"]
+            assert "deequ_service_fast_path_folds_total" not in counters
+            assert s.batches_ingested == 1
+        finally:
+            svc.close()
+
+
+class TestParity:
+    def test_fast_path_bit_exact_vs_serial_both_tiers(self, monkeypatch):
+        req = [ApproxCountDistinct("k")]
+        fast, counters = _run_stream(
+            "1", monkeypatch=monkeypatch, required=req)
+        assert sum(
+            counters["deequ_service_fast_path_folds_total"].values()
+        ) == 3
+        serial_auto, _ = _run_stream(
+            "0", monkeypatch=monkeypatch, required=req)
+        serial_host, _ = _run_stream(
+            "0", placement="host", monkeypatch=monkeypatch, required=req)
+        assert fast == serial_auto  # bit-exact, device-tier serial
+        assert fast == serial_host  # bit-exact, host-tier serial
+
+    def test_coalesced_device_parity_vs_serial(self, monkeypatch):
+        """3 sessions' folds stacked into vmapped launches: accumulator
+        classes bit-exact vs the serial device path; KLL within its
+        sketch envelope; Correlation within reduction-order ulps."""
+        from deequ_tpu.analyzers import ApproxQuantile
+
+        req = [
+            StandardDeviation("y"), Correlation("x", "y"),
+            ApproxCountDistinct("k"),
+            ApproxQuantile("y", 0.5),  # KLL state through the vmapped fold
+        ]
+        dev, counters = _run_stream(
+            "1", monkeypatch=monkeypatch, required=req, sessions=3,
+            workers=1, pipelined=True, force_device=True,
+        )
+        assert counters["deequ_service_coalesced_folds_total"] == 9
+        ser, _ = _run_stream(
+            "0", monkeypatch=monkeypatch, required=req, sessions=3,
+            workers=1, pipelined=True,
+        )
+        for got, want in zip(dev, ser):
+            assert set(got) == set(want)
+            for key in want:
+                if "ApproxQuantile" in key or "KLL" in key:
+                    assert got[key] == pytest.approx(want[key], rel=2e-2)
+                elif "Correlation" in key:
+                    assert got[key] == pytest.approx(want[key], rel=1e-9)
+                else:
+                    assert got[key] == want[key], key
+
+    def test_coalesced_launch_width_recorded(self, monkeypatch):
+        _, counters = _run_stream(
+            "1", monkeypatch=monkeypatch, sessions=4, workers=1,
+            pipelined=True, batches=2, force_device=True,
+        )
+        widths = counters["deequ_service_coalesce_width_total"]
+        # 1 worker + pipelined submits: drains find peers (width > 1)
+        assert any(k != "width=1" for k in widths)
+        assert counters["deequ_service_coalesce_width_sum"] == 8
+
+
+class TestFifoAndAtomicity:
+    def test_per_session_fifo_under_coalescing(self, monkeypatch):
+        """Pipelined folds of many sessions drain cross-session, but each
+        session's folds must commit in submission order: cumulative Size
+        over batches 1..N is strictly increasing in each session's result
+        ring, and batch counts equal folds submitted."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=4, background_warm=False)
+        try:
+            n_sessions, n_batches = 8, 6
+            sess = [
+                svc.session(f"t{i}", "fifo", _checks())
+                for i in range(n_sessions)
+            ]
+            handles = []
+            for b in range(n_batches):
+                for i, s in enumerate(sess):
+                    handles.append(
+                        s.ingest(_table(512, seed=i * 100 + b), wait=False)
+                    )
+            for h in handles:
+                h.result(180)
+            for s in sess:
+                assert s.batches_ingested == n_batches
+                sizes = []
+                for r in s.results:
+                    for a, m in r.metrics.items():
+                        if a.name == "Size":
+                            sizes.append(m.value.get())
+                assert sizes == sorted(sizes)
+                assert sizes[-1] == 512 * n_batches
+        finally:
+            svc.close()
+
+    def test_on_result_delivered_once_per_fold(self, monkeypatch):
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=2, background_warm=False)
+        seen = []
+        lock = threading.Lock()
+
+        def cb(result):
+            with lock:
+                seen.append(result)
+
+        try:
+            s = svc.session("t", "cb", _checks(), on_result=cb)
+            hs = [s.ingest(_table(256, seed=i), wait=False) for i in range(5)]
+            for h in hs:
+                h.result(60)
+            assert len(seen) == 5
+        finally:
+            svc.close()
+
+    def test_retried_job_never_refolds(self, monkeypatch):
+        """A fold executed by a drain is memoized: its job (or a retry of
+        it) consumes the result instead of folding again."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            s = svc.session("t", "memo", _checks(), max_retries=2)
+            for i in range(4):
+                s.ingest(_table(256, seed=i))
+            assert s.batches_ingested == 4
+            assert s.rows_ingested == 4 * 256
+        finally:
+            svc.close()
+
+
+@pytest.mark.chaos
+class TestFaultIsolation:
+    def test_fault_mid_coalesced_launch_quarantines_owner_only(
+        self, monkeypatch
+    ):
+        """An injected fault inside the joint launch must fail ONLY the
+        owning session's fold (group bisection), with the siblings'
+        folds committed."""
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.service import JobFailed
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        monkeypatch.setenv(FAST_PATH_MAX_ROWS_ENV, "0")  # device route
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            sess = [
+                svc.session(f"t{i}", "chaos", _checks()) for i in range(4)
+            ]
+            with inject(
+                FaultSpec("coalesced_fold", "poison", every=1, count=None,
+                          match="t2/chaos")
+            ):
+                handles = [
+                    s.ingest(_table(512, seed=i), wait=False)
+                    for i, s in enumerate(sess)
+                ]
+                outcomes = []
+                for h in handles:
+                    try:
+                        outcomes.append(("ok", h.result(120)))
+                    except JobFailed as exc:
+                        outcomes.append(("failed", exc))
+            assert [o[0] for o in outcomes] == ["ok", "ok", "failed", "ok"]
+            for i, s in enumerate(sess):
+                assert s.batches_ingested == (0 if i == 2 else 1)
+            quarantined = svc.metrics.counter_value(
+                "deequ_service_coalesce_quarantined_total"
+            )
+            assert quarantined == 1
+        finally:
+            svc.close()
+
+    def test_fast_fold_fault_fails_alone(self, monkeypatch):
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.service import JobFailed
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            sess = [
+                svc.session(f"t{i}", "fchaos", _checks()) for i in range(3)
+            ]
+            with inject(
+                FaultSpec("coalesced_fold", "poison", every=1, count=None,
+                          match="t1/fchaos")
+            ):
+                handles = [
+                    s.ingest(_table(512, seed=i), wait=False)
+                    for i, s in enumerate(sess)
+                ]
+                results = []
+                for h in handles:
+                    try:
+                        h.result(60)
+                        results.append("ok")
+                    except JobFailed:
+                        results.append("failed")
+            assert results == ["ok", "failed", "ok"]
+            assert [s.batches_ingested for s in sess] == [1, 0, 1]
+        finally:
+            svc.close()
+
+
+class TestCrossoverRouter:
+    def test_route_respects_env_override(self, monkeypatch):
+        router = CrossoverRouter()
+        from deequ_tpu.data import Dataset
+
+        data = Dataset.from_arrow(_table(64, seed=1))
+        plan = build_fold_plan([Size(), Mean("y")], data.schema)
+        assert plan is not None and plan.fast_ok
+        monkeypatch.setenv(FAST_PATH_MAX_ROWS_ENV, "1000")
+        assert router.route(plan, 1000) == "fast"
+        assert router.route(plan, 1001) == "device"
+        monkeypatch.setenv(FAST_PATH_MAX_ROWS_ENV, "0")
+        assert router.route(plan, 1) == "device"
+
+    def test_measured_rates_move_the_crossover(self):
+        router = CrossoverRouter()
+        before = router.crossover_rows([Mean])
+        # a faster measured host kernel pushes the crossover up
+        for _ in range(50):
+            router.observe_host(Mean, 1_000_000, 0.02)  # 50M rows/s
+        after = router.crossover_rows([Mean])
+        assert after > before
+        # a host rate above the device's per-row rate: host never loses
+        fast_router = CrossoverRouter()
+        for _ in range(50):
+            fast_router.observe_host(Mean, 1_000_000, 0.001)  # 1e9 rows/s
+        assert fast_router.crossover_rows([Mean]) == 1 << 62
+        # a cheaper measured device fixed cost pulls the crossover down
+        for _ in range(50):
+            router.observe_device(4096, 0.0005, 1)
+        assert router.crossover_rows([Mean]) < after
+
+    def test_non_transparent_classes_never_fast(self):
+        from deequ_tpu.data import Dataset
+
+        data = Dataset.from_arrow(_table(64, seed=1))
+        plan = build_fold_plan(
+            [Size(), StandardDeviation("y")], data.schema
+        )
+        assert plan is not None and not plan.fast_ok
+        assert CrossoverRouter().route(plan, 16) == "device"
+
+    def test_plan_ineligible_for_grouping_and_preconditions(self):
+        from deequ_tpu.data import Dataset
+
+        data = Dataset.from_arrow(_table(64, seed=1))
+        assert build_fold_plan([Uniqueness(["k"])], data.schema) is None
+        assert build_fold_plan([Mean("missing")], data.schema) is None
+        assert build_fold_plan([], data.schema) is None
+
+    def test_knob_defaults(self, monkeypatch):
+        assert coalesce_enabled()
+        monkeypatch.setenv(COALESCE_ENV, "0")
+        assert not coalesce_enabled()
+
+
+class TestHostMerge:
+    def test_host_merge_matches_compiled_merge_bitwise(self):
+        import jax
+
+        from deequ_tpu.analyzers.states import (
+            ApproxCountDistinctState,
+            DataTypeHistogram,
+            MaxState,
+            MeanState,
+            MinState,
+            NumMatches,
+            NumMatchesAndCount,
+            SumState,
+            host_merge,
+        )
+
+        rng = np.random.default_rng(11)
+
+        def np_state(cls, *leaves):
+            return cls(*[np.asarray(l) for l in leaves])
+
+        cases = []
+        for _ in range(200):
+            a, b = rng.normal(0, 1e6, 2)
+            n1, n2 = rng.integers(0, 1 << 40, 2)
+            cases.extend([
+                (np_state(NumMatches, np.int64(n1)),
+                 np_state(NumMatches, np.int64(n2))),
+                (np_state(MeanState, a, np.int64(n1)),
+                 np_state(MeanState, b, np.int64(n2))),
+                (np_state(SumState, a, np.int64(n1)),
+                 np_state(SumState, b, np.int64(n2))),
+                (np_state(MinState, a, np.int64(n1)),
+                 np_state(MinState, b, np.int64(n2))),
+                (np_state(MaxState, a, np.int64(n1)),
+                 np_state(MaxState, b, np.int64(n2))),
+                (np_state(NumMatchesAndCount, np.int64(n1), np.int64(n2)),
+                 np_state(NumMatchesAndCount, np.int64(n2), np.int64(n1))),
+            ])
+        # NaN / inf edges of the ordered states
+        for edge in (np.nan, np.inf, -np.inf, -0.0, 0.0):
+            cases.append((np_state(MinState, edge, np.int64(1)),
+                          np_state(MinState, 1.5, np.int64(1))))
+            cases.append((np_state(MaxState, 1.5, np.int64(1)),
+                          np_state(MaxState, edge, np.int64(1))))
+        cases.append((
+            np_state(DataTypeHistogram,
+                     rng.integers(0, 1 << 30, 5).astype(np.int64)),
+            np_state(DataTypeHistogram,
+                     rng.integers(0, 1 << 30, 5).astype(np.int64)),
+        ))
+        cases.append((
+            np_state(ApproxCountDistinctState,
+                     rng.integers(0, 30, 512).astype(np.int32)),
+            np_state(ApproxCountDistinctState,
+                     rng.integers(0, 30, 512).astype(np.int32)),
+        ))
+        for sa, sb in cases:
+            got = host_merge(sa, sb)
+            want = jax.device_get(sa.merge(sb))
+            for g, w in zip(
+                jax.tree_util.tree_leaves(got),
+                jax.tree_util.tree_leaves(want),
+            ):
+                ga, wa = np.asarray(g), np.asarray(w)
+                assert ga.dtype.kind == wa.dtype.kind
+                assert np.array_equal(ga, wa, equal_nan=True), (sa, sb)
+
+    def test_host_merge_refuses_non_transparent(self):
+        from deequ_tpu.analyzers.states import (
+            StandardDeviationState,
+            host_merge,
+        )
+
+        s = StandardDeviationState(
+            np.float64(1), np.float64(2), np.float64(3)
+        )
+        with pytest.raises(TypeError):
+            host_merge(s, s)
+
+    def test_identity_transparency_claims_hold(self):
+        """merge(init, s) == s at the BIT level for every class in the
+        registry — the algebraic fact the fast path rests on."""
+        import jax
+
+        from deequ_tpu.analyzers.states import (
+            IDENTITY_TRANSPARENT_STATES,
+            ApproxCountDistinctState,
+            DataTypeHistogram,
+            FrequencyCountsState,
+            MaxState,
+            MeanState,
+            MinState,
+            NumMatches,
+            NumMatchesAndCount,
+            SumState,
+        )
+
+        rng = np.random.default_rng(4)
+        samples = {
+            NumMatches: lambda: NumMatches(
+                np.int64(rng.integers(0, 1 << 50))),
+            NumMatchesAndCount: lambda: NumMatchesAndCount(
+                np.int64(rng.integers(0, 1 << 50)),
+                np.int64(rng.integers(0, 1 << 50))),
+            MeanState: lambda: MeanState(
+                np.float64(rng.normal(0, 1e9)),
+                np.int64(rng.integers(0, 1 << 50))),
+            SumState: lambda: SumState(
+                np.float64(rng.normal(0, 1e9)),
+                np.int64(rng.integers(0, 1 << 50))),
+            MinState: lambda: MinState(
+                np.float64(rng.normal()), np.int64(1)),
+            MaxState: lambda: MaxState(
+                np.float64(rng.normal()), np.int64(1)),
+            DataTypeHistogram: lambda: DataTypeHistogram(
+                rng.integers(0, 1 << 40, 5).astype(np.int64)),
+            ApproxCountDistinctState: lambda: ApproxCountDistinctState(
+                rng.integers(0, 31, 512).astype(np.int32)),
+            FrequencyCountsState: lambda: FrequencyCountsState(
+                rng.integers(0, 1 << 40, 16).astype(np.int64),
+                np.int64(rng.integers(0, 1 << 50))),
+        }
+        assert set(samples) == set(IDENTITY_TRANSPARENT_STATES)
+        for cls, make in samples.items():
+            if cls is FrequencyCountsState:
+                init = FrequencyCountsState.init(16)
+            else:
+                init = cls.init()
+            for _ in range(25):
+                s = make()
+                merged = jax.device_get(init.merge(s))
+                for m, o in zip(
+                    jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(s),
+                ):
+                    assert np.array_equal(
+                        np.asarray(m), np.asarray(o), equal_nan=True
+                    ), cls
+
+
+class TestSchedulerDiet:
+    def test_absorbed_jobs_resolve_without_running(self, monkeypatch):
+        """Under a drain, sibling jobs finish straight from the queue:
+        every handle resolves, phases are harvested, stream counters hold
+        the exact fold count."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=2, background_warm=False)
+        try:
+            sess = [
+                svc.session(f"t{i}", "abs", _checks()) for i in range(16)
+            ]
+            handles = [
+                s.ingest(_table(256, seed=i), wait=False)
+                for i, s in enumerate(sess)
+            ]
+            for h in handles:
+                r = h.result(120)
+                assert r.status == CheckStatus.SUCCESS
+            assert svc.metrics.counter_value(
+                "deequ_service_stream_batches_total"
+            ) == 16
+            assert svc.metrics.counter_value(
+                "deequ_service_jobs_completed_total"
+            ) >= 16
+            # phase harvests reached the export plane for absorbed folds
+            assert svc.metrics.counter_value(
+                "deequ_service_phase_seconds_total", phase="host_partials"
+            ) > 0
+        finally:
+            svc.close()
+
+    def test_backpressure_and_shed_semantics_unchanged(self, monkeypatch):
+        from deequ_tpu.service import ServiceOverloaded
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(
+            workers=1, max_queue_depth=2, background_warm=False
+        )
+        try:
+            gate = threading.Event()
+            svc.scheduler.submit(lambda ctx: gate.wait(20))
+            time.sleep(0.1)
+            s = svc.session("t", "bp", _checks())
+            s.ingest(_table(128, seed=1), wait=False)
+            s.ingest(_table(128, seed=2), wait=False)
+            with pytest.raises(ServiceOverloaded):
+                s.ingest(_table(128, seed=3), wait=False)
+            gate.set()
+        finally:
+            svc.close()
+
+    def test_deadlined_folds_never_cross_drain(self, monkeypatch):
+        """A fold with a deadline executes only under its own job (the
+        queued-past-deadline contract needs the scheduler's clock), so
+        it must not be claimable by another session's drain."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=2, background_warm=False)
+        try:
+            s = svc.session("t", "dl", _checks(), deadline_s=30.0)
+            r = s.ingest(_table(256, seed=1))
+            assert r.status == CheckStatus.SUCCESS
+            assert s.batches_ingested == 1
+        finally:
+            svc.close()
+
+
+class TestStreamingSemantics:
+    def test_drift_reject_unchanged_under_coalescing(self, monkeypatch):
+        from deequ_tpu.exceptions import SchemaDriftError
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            s = svc.session("t", "drift", _checks())
+            s.ingest(_table(256, seed=1))
+            drifted = pa.table({"x": np.zeros(16)})
+            with pytest.raises(SchemaDriftError):
+                s.ingest(drifted)
+            assert s.batches_ingested == 1
+        finally:
+            svc.close()
+
+    def test_contract_commits_after_first_coalesced_fold(self, monkeypatch):
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            s = svc.session("t", "contract", _checks())
+            assert s._contract is None
+            s.ingest(_table(256, seed=1))
+            assert s._contract is not None
+        finally:
+            svc.close()
+
+    def test_closed_session_rejects_typed(self, monkeypatch):
+        from deequ_tpu.service import SessionClosed
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=1, background_warm=False)
+        try:
+            s = svc.session("t", "closed", _checks())
+            s.ingest(_table(256, seed=1))
+            s.close()
+            with pytest.raises(SessionClosed):
+                s.ingest(_table(256, seed=2))
+        finally:
+            svc.close()
+
+    def test_monitor_counters_reach_run_monitor(self, monkeypatch):
+        from deequ_tpu.runners.engine import RunMonitor
+
+        m = RunMonitor()
+        other = RunMonitor()
+        other.fast_path_folds = 2
+        other.coalesced_folds = 3
+        other.batches = 5
+        other.phase_seconds = {"host_partials": 0.5}
+        other.cost_by_analyzer = {"Mean": 0.1}
+        m.merge_from(other)
+        m.merge_from(RunMonitor())
+        assert m.fast_path_folds == 2
+        assert m.coalesced_folds == 3
+        assert m.batches == 5
+        assert m.phase_seconds["host_partials"] == 0.5
+        assert m.cost_by_analyzer["Mean"] == 0.1
+
+
+class TestOrderingAcrossKeys:
+    """Review-hardening pins: per-session FIFO must hold even when a
+    session's folds land under DIFFERENT coalesce keys (varying buckets)
+    or mix serial-path folds between coalesced ones."""
+
+    def test_drain_never_claims_past_an_older_fold_in_another_key(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=0, background_warm=False)
+        try:
+            co = svc.coalescer
+            from deequ_tpu.ingest.columnar import as_dataset
+
+            s1 = svc.session("v", "d", _checks())
+            s2 = svc.session("w", "d", _checks())
+            small = as_dataset(_table(512, seed=1))   # bucket 1024
+            big1 = as_dataset(_table(3000, seed=2))   # bucket 4096
+            big2 = as_dataset(_table(3000, seed=3))
+            p1 = co.prepare(s1, small, 1024)
+            p2 = co.prepare(s1, big1, 4096)
+            p3 = co.prepare(s2, big2, 4096)
+            for p in (p1, p2, p3):
+                assert p is not None
+                co.mark_submitted(p)
+            assert p2.key == p3.key and p1.key != p2.key
+            with co._lock:
+                group = co._claim_group_locked(p3)
+            # s1's oldest outstanding fold is p1 (a DIFFERENT key): the
+            # drain on p3's key must NOT claim p2 ahead of it
+            assert group == [p3]
+            # once p1 completes, p2 becomes s1's head and is drainable
+            co._complete(p1, result="r1")
+            with co._lock:
+                extra = co._claim_sweep_locked(p3.key)
+            assert extra == [p2]
+        finally:
+            svc.close()
+
+    def test_serial_barrier_blocks_cross_drain(self, monkeypatch):
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=0, background_warm=False)
+        try:
+            co = svc.coalescer
+            from deequ_tpu.ingest.columnar import as_dataset
+
+            s1 = svc.session("v", "bar", _checks())
+            s2 = svc.session("w", "bar", _checks())
+            assert co.note_serial_fold(s1)  # an outstanding serial fold
+            p1 = co.prepare(s1, as_dataset(_table(256, seed=1)), 1024)
+            p2 = co.prepare(s2, as_dataset(_table(256, seed=2)), 1024)
+            co.mark_submitted(p1)
+            co.mark_submitted(p2)
+            with co._lock:
+                group = co._claim_group_locked(p2)
+            assert group == [p2]  # p1 barred by the serial barrier
+            co.clear_serial_barrier(("v", "bar"))
+            with co._lock:
+                extra = co._claim_sweep_locked(p1.key)
+            assert extra == [p1]
+        finally:
+            svc.close()
+
+    def test_mixed_bucket_pipelined_session_commits_in_order(
+        self, monkeypatch
+    ):
+        """End-to-end: a session alternating micro-batch sizes (two
+        coalesce keys) among many same-key sessions must still see its
+        cumulative Size grow monotonically in submission order."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=4, background_warm=False)
+        try:
+            victim = svc.session("v", "mix", _checks())
+            peers = [
+                svc.session(f"p{i}", "mix", _checks()) for i in range(6)
+            ]
+            handles = []
+            sizes = [512, 3000, 700, 2500, 900, 3500]
+            for b, rows in enumerate(sizes):
+                handles.append(
+                    victim.ingest(_table(rows, seed=b), wait=False)
+                )
+                for i, p in enumerate(peers):
+                    handles.append(
+                        p.ingest(_table(3000, seed=100 + i), wait=False)
+                    )
+            for h in handles:
+                h.result(180)
+            cum = []
+            for r in victim.results:
+                for a, m in r.metrics.items():
+                    if a.name == "Size":
+                        cum.append(m.value.get())
+            assert cum == [float(sum(sizes[: i + 1]))
+                           for i in range(len(sizes))]
+        finally:
+            svc.close()
+
+
+class TestRetrySemantics:
+    def test_failed_fold_reexecutes_on_retry(self, monkeypatch):
+        """A memoized FAILURE must re-run on a scheduler retry (the
+        serial done-dict memoizes only committed results); the retry
+        commits the batch exactly once."""
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.runners.engine import RunMonitor
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=0, background_warm=False)
+        try:
+            co = svc.coalescer
+            from deequ_tpu.ingest.columnar import as_dataset
+
+            s = svc.session("t", "retry", _checks())
+            p = co.prepare(s, as_dataset(_table(256, seed=5)), 1024)
+            co.mark_submitted(p)
+
+            class Ctx:
+                def __init__(self, attempt):
+                    self.attempt = attempt
+                    self.worker_id = 0
+                    self.monitor = RunMonitor()
+
+            with inject(
+                FaultSpec("coalesced_fold", "poison", at=1)
+            ):
+                with pytest.raises(Exception):
+                    co.run_fold(Ctx(1), p)
+                assert s.batches_ingested == 0
+                # the scheduler re-dispatches: attempt 2 must RE-EXECUTE
+                result = co.run_fold(Ctx(2), p)
+            assert result is not None
+            assert s.batches_ingested == 1
+        finally:
+            svc.close()

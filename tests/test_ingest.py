@@ -1,6 +1,7 @@
 """Ingestion plane: columnar coercion, Arrow IPC frontend, HTTP endpoint,
 prefetch pipeline, bounded-admission backpressure (PR 9)."""
 
+import json
 import threading
 import time
 
@@ -649,3 +650,133 @@ class TestSoakSmoke:
         )
         assert summary["ok"] and summary["parity_ok"]
         assert summary["frames"] >= 1
+
+
+class TestIncrementalHttpDecode:
+    """The unbuffered ingest path: an unchecksummed POST decodes frame by
+    frame straight off the socket — one frame in memory, not the body."""
+
+    def _post_chunked(self, exporter, path, payload, chunks, gap_s=0.02):
+        import socket
+
+        sock = socket.create_connection((exporter.host, exporter.port))
+        head = (
+            f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n"
+        ).encode()
+        sock.sendall(head)
+        step = -(-len(payload) // chunks)
+        for i in range(0, len(payload), step):
+            sock.sendall(payload[i:i + step])
+            time.sleep(gap_s)
+        resp = b""
+        sock.settimeout(20)
+        try:
+            while b"\r\n\r\n" not in resp or len(resp) < 10:
+                part = sock.recv(65536)
+                if not part:
+                    break
+                resp += part
+        except OSError:
+            pass
+        sock.close()
+        status = int(resp.split(b" ", 2)[1])
+        body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+        return status, body
+
+    def test_frames_fold_while_body_still_arriving(self, service):
+        """Frames committed BEFORE the transport delivered the full body
+        prove the decode is incremental, not buffered."""
+        session = service.session("inc", "stream", _checks())
+        exporter = service.start_exporter()
+        table = _table(4000)
+        payload = encode_ipc_stream(table, max_chunksize=1000)
+        committed_mid_body = []
+
+        orig = type(session)._commit_fold
+
+        def spy(self, result, data, pending_contract, done):
+            committed_mid_body.append(time.perf_counter())
+            return orig(self, result, data, pending_contract, done)
+
+        import deequ_tpu.service.streaming as streaming_mod
+
+        streaming_mod.StreamingSession._commit_fold = spy
+        try:
+            t0 = time.perf_counter()
+            status, body = self._post_chunked(
+                exporter, "/ingest/v1/inc/stream", payload, chunks=8,
+                gap_s=0.05,
+            )
+            last_byte_at = t0 + 7 * 0.05  # the 8th chunk leaves then
+        finally:
+            streaming_mod.StreamingSession._commit_fold = orig
+        assert status == 200 and body["frames"] == 4
+        assert session.batches_ingested == 4
+        # at least the first frame folded before the final chunk was sent
+        assert committed_mid_body[0] < last_byte_at
+
+    def test_incremental_equivalent_to_buffered(self, service):
+        """HTTP-fed (incremental) == checksummed HTTP-fed (buffered) ==
+        in-process fold_stream, bit-exact."""
+        import urllib.request
+
+        table = _table(3000)
+        payload = encode_ipc_stream(table, max_chunksize=1000)
+        exporter = service.start_exporter()
+        for name, headers in (
+            ("plain", {}),
+            ("csum", {CHECKSUM_HEADER: checksum_bytes(payload)}),
+        ):
+            service.session(f"eq-{name}", "s", _checks())
+            req = urllib.request.Request(
+                f"http://{exporter.host}:{exporter.port}"
+                f"/ingest/v1/eq-{name}/s",
+                data=payload, headers=headers, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+        direct = service.session("eq-direct", "s", _checks())
+        fold_stream(direct, payload, source="direct")
+        maps = []
+        for name in ("eq-plain", "eq-csum", "eq-direct"):
+            s = service.get_session(name, "s")
+            cum = s.current()
+            maps.append({
+                repr(a): m.value.get()
+                for a, m in cum.metrics.items() if m.value.is_success
+            })
+        assert maps[0] == maps[1] == maps[2]
+
+    def test_incremental_malformed_drains_and_400s(self, service):
+        import urllib.error
+        import urllib.request
+
+        service.session("inc", "bad", _checks())
+        exporter = service.start_exporter()
+        req = urllib.request.Request(
+            f"http://{exporter.host}:{exporter.port}/ingest/v1/inc/bad",
+            data=b"definitely not an arrow stream, padded " + b"x" * 500,
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 400
+        assert json.loads(err.value.read())["error"] == "malformed_frame"
+
+    def test_bounded_reader_contract(self):
+        import io
+
+        from deequ_tpu.ingest.arrow_stream import BoundedReader
+
+        raw = io.BytesIO(b"abcdefghij")
+        r = BoundedReader(raw, 6)
+        assert r.read(4) == b"abcd"
+        assert r.read(100) == b"ef"  # capped at the declared limit
+        assert r.read(1) == b""
+        assert r.bytes_read == 6 and not r.short
+        short = BoundedReader(io.BytesIO(b"ab"), 10)
+        assert short.read(10) == b"ab"
+        assert short.short and short.bytes_read == 2
+        short.drain()
+        assert short.bytes_read == 2

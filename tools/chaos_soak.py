@@ -173,6 +173,7 @@ def run_soak(
             summary["repo_drill"] = _repository_drill(data, state_root)
             summary["mesh_drill"] = _mesh_drill(data)
             summary["ingest_drill"] = _ingest_drill(service)
+            summary["coalesce_drill"] = _coalesce_drill(service)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -191,6 +192,7 @@ def run_soak(
         and summary["repo_drill"]["ok"]
         and summary["mesh_drill"]["ok"]
         and summary["ingest_drill"]["ok"]
+        and summary["coalesce_drill"]["ok"]
     )
     return summary
 
@@ -238,6 +240,67 @@ def _mesh_drill(data) -> Dict:
         "parity": parity,
         "ok": parity and mon.shard_losses >= 1 and mon.mesh_reshards >= 1,
     }
+
+
+def _coalesce_drill(service) -> Dict:
+    """Cross-session fold coalescing drill, run inside the soak against
+    the live service: four sessions' micro-batch folds are forced onto
+    the coalesced DEVICE path (``DEEQU_TPU_FAST_PATH_MAX_ROWS=0``) and an
+    injected ``coalesced_fold`` poison matching ONE session's tag fires
+    on every launch attempt — group bisection must quarantine exactly
+    that session (typed JobFailed, zero batches committed) while the
+    three siblings commit their folds. ``inject`` swaps the soak's
+    ambient plan out so an ambient hit cannot shift the pinned counts."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.reliability import FaultSpec, inject
+    from deequ_tpu.service.errors import JobFailed
+
+    checks = _checks()
+    out: Dict = {}
+    os.environ["DEEQU_TPU_FAST_PATH_MAX_ROWS"] = "0"
+    try:
+        with inject(FaultSpec(
+            "coalesced_fold", "poison", every=1, count=None,
+            match="coalesce-drill-2/stream",
+        )):
+            sessions = [
+                service.session(f"coalesce-drill-{i}", "stream", checks)
+                for i in range(4)
+            ]
+            handles = []
+            for i, s in enumerate(sessions):
+                r = np.random.default_rng(40 + i)
+                table = pa.table({
+                    "x": r.normal(size=512),
+                    "y": r.normal(10.0, 2.0, size=512),
+                    "cat": pa.array([f"c{j % 13}" for j in range(512)]),
+                })
+                handles.append(s.ingest(table, wait=False))
+            outcomes = []
+            for h in handles:
+                try:
+                    h.result(120)
+                    outcomes.append("ok")
+                except JobFailed:
+                    outcomes.append("quarantined")
+                except Exception:  # noqa: BLE001 - verdict below
+                    outcomes.append("untyped")
+    finally:
+        os.environ.pop("DEEQU_TPU_FAST_PATH_MAX_ROWS", None)
+    out["outcomes"] = outcomes
+    out["committed"] = [s.batches_ingested for s in sessions]
+    out["quarantined_counter"] = service.metrics.counter_value(
+        "deequ_service_coalesce_quarantined_total"
+    )
+    out["ok"] = (
+        outcomes == ["ok", "ok", "quarantined", "ok"]
+        and out["committed"] == [1, 1, 0, 1]
+    )
+    return out
 
 
 def _ingest_drill(service) -> Dict:
