@@ -1624,8 +1624,11 @@ def main() -> None:
             "points": [
                 {k: p[k] for k in (
                     "sessions", "rows", "serial_sessions_per_s",
-                    "coalesced_sessions_per_s", "speedup", "shed",
-                )}
+                    "coalesced_sessions_per_s",
+                    "coalesced_sessions_per_s_min",
+                    "coalesced_sessions_per_s_max",
+                    "speedup", "shed",
+                ) if k in p}
                 for p in knee["points"]
             ],
             "parity_bit_exact": knee["parity"]["bit_exact"],
@@ -1639,12 +1642,20 @@ def main() -> None:
         out["mesh_scaling_rows_per_sec"] = {
             k: round(v, 1) for k, v in mesh_scaling["points"].items()
         }
+        # the SUBSTRATE rides the partial JSON so a virtual-CPU-device
+        # scaling curve can never be misread as an accelerator one (the
+        # r06 vs_baseline lesson applied to mesh points): real mesh vs
+        # 8-virtual-CPU-device fallback, device kind, chip count
+        substrate = mesh_scaling.get("mesh_substrate") or {}
+        if substrate:
+            out["mesh_substrate"] = substrate
         chaos = mesh_scaling.get("chaos") or {}
         if chaos:
             out["mesh_recovery_s"] = chaos["recovery_s"]
             out["mesh_chaos_parity_ok"] = chaos["parity_ok"]
         checkpoint("mesh_scaling", extra={
             "points": {k: round(v, 1) for k, v in mesh_scaling["points"].items()},
+            **({"mesh_substrate": substrate} if substrate else {}),
             **({"chaos": chaos} if chaos else {}),
         })
 
@@ -1656,6 +1667,44 @@ def main() -> None:
         out["suggest_cold_seconds"] = round(suggest["cold_seconds"], 2)
         out["suggestions"] = suggest["suggestions"]
         checkpoint("suggest")
+
+    # perf-regression EPILOGUE (ROADMAP item 1's standing gate): diff this
+    # run against the latest committed BENCH_r*/KNEE_r* trajectory and
+    # record the verdict in the artifact. Report-only here — the bench's
+    # job is to emit its numbers; CI enforces with `python -m
+    # tools.bench_diff <fresh.json>` whose exit code is the gate.
+    def run_bench_diff_stage() -> dict:
+        from tools.bench_diff import render_report, run_diff_on_metrics
+
+        fresh = dict(out)
+        fresh["stages"] = dict(stages)
+        fresh["completed_stages"] = list(completed)
+        try:
+            # ONE orchestration shared with the CLI gate (`python -m
+            # tools.bench_diff`): same baseline/knee discovery, same
+            # comparison — the epilogue and CI can never disagree about
+            # what was compared
+            result = run_diff_on_metrics(
+                fresh, repo_dir=os.path.dirname(os.path.abspath(__file__))
+            )
+        except FileNotFoundError:
+            return {"ok": True, "note": "no committed baseline parses",
+                    "regressions": []}
+        for line in render_report(result).splitlines():
+            log(f"[bench_diff] {line}")
+        return result
+
+    bench_diff = staged("bench_diff", run_bench_diff_stage)
+    if bench_diff is not None:
+        out["bench_diff_ok"] = bench_diff["ok"]
+        checkpoint("bench_diff", extra={
+            "ok": bench_diff["ok"],
+            "baseline": bench_diff.get("baseline"),
+            "regressions": [
+                f"{r['stage']}:{r['metric']}"
+                for r in bench_diff.get("regressions", [])
+            ],
+        })
 
     final = dict(out)
     final["partial"] = False

@@ -144,6 +144,25 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Fleet scheduler (implemented in deequ_tpu.service.fleet; the env knobs
+# are documented here with the other operator-facing switches and
+# re-exported below). Both follow the warn-and-fallback convention.
+#
+# - DEEQU_TPU_FLEET: "0" disables fleet scheduling entirely — single-chip
+#   routing, byte-for-byte the pre-fleet service path (the escape hatch);
+#   "1" forces it on even on the CPU backend (virtual-device drills and
+#   tests); unset = ON exactly when the backend is a real accelerator
+#   with more than one chip. When on, every tenant's batch scans shard
+#   across that tenant's DISJOINT sub-mesh slice of the device mesh, and
+#   fleet-sized streaming deltas fold shard-local + butterfly-merge at
+#   coalesce-drain boundaries.
+# - DEEQU_TPU_FLEET_STREAM_MIN_ROWS: minimum micro-batch rows before a
+#   streaming fold shards over the tenant's sub-mesh (default 65536 —
+#   below it the single-chip coalesced/fast paths beat the collective's
+#   latency; 0 shards every eligible fold, the fleet drills use it).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -201,6 +220,10 @@ from .service.coalesce import (  # noqa: E402,F401
     COALESCE_ENV,
     COALESCE_MAX_WIDTH_ENV,
     FAST_PATH_MAX_ROWS_ENV,
+)
+from .service.fleet import (  # noqa: E402,F401
+    FLEET_ENV,
+    FLEET_STREAM_MIN_ROWS_ENV,
 )
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401

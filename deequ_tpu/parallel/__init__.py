@@ -397,16 +397,19 @@ def collective_merge_states(analyzers: Sequence[Any], mesh: Mesh, per_shard_stat
 from .elastic import (  # noqa: E402,F401
     ElasticMeshFold,
     MESH_LADDER_ENV,
+    add_shard_loss_listener,
     host_merge_states,
     mesh_batch_quantum,
     mesh_ladder,
     next_rung,
+    remove_shard_loss_listener,
     salvage_stacked_states,
     stack_canonical_states,
 )
 from .health import (  # noqa: E402,F401
     HEARTBEAT_ENV,
     HeartbeatGate,
+    probe_devices,
     probe_shards,
     shard_heartbeat_s,
 )

@@ -178,6 +178,34 @@ def salvage_stacked_states(
     return shard_states, salvaged
 
 
+#: fleet-level subscribers told which DEVICE OBJECTS a salvage declared
+#: lost, the moment the elastic layer knows — so a fleet scheduler can
+#: re-pack tenants off the dead chip without waiting for the job's
+#: post-run harvest. Advisory: a raising listener is logged, never allowed
+#: to break the recovery it observes.
+_SHARD_LOSS_LISTENERS: List[Any] = []
+
+
+def add_shard_loss_listener(fn) -> None:
+    if fn not in _SHARD_LOSS_LISTENERS:
+        _SHARD_LOSS_LISTENERS.append(fn)
+
+
+def remove_shard_loss_listener(fn) -> None:
+    try:
+        _SHARD_LOSS_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_shard_loss(devices: Sequence) -> None:
+    for fn in list(_SHARD_LOSS_LISTENERS):
+        try:
+            fn(devices)
+        except Exception:  # noqa: BLE001 - listeners are advisory
+            _logger.warning("shard-loss listener failed", exc_info=True)
+
+
 class MeshExhaustedError(RuntimeError):
     """Internal: no ladder rung fits the survivors (callers drop to host
     mode; this never escapes ElasticMeshFold)."""
@@ -346,6 +374,9 @@ class ElasticMeshFold:
         devices = list(self.mesh.devices.flat)
         old_n = len(devices)
         record_failure(exc)
+        # tell fleet-level subscribers WHICH devices died (positions are
+        # mesh-local; device objects are global identities)
+        _notify_shard_loss([devices[i] for i in lost if 0 <= i < old_n])
         _trace.add_event(
             "shard_loss", site=getattr(exc, "site", ""), lost=lost,
             mesh_devices=old_n,

@@ -71,9 +71,15 @@ def shard_heartbeat_s() -> Optional[float]:
 
 def probe_shards(mesh, deadline_s: Optional[float] = None) -> List[int]:
     """Probe every device of ``mesh`` and return the DEAD mesh positions
-    (indices into ``mesh.devices.flat``): a probe that raises, or that
-    fails to complete within ``deadline_s`` (default: the heartbeat
-    interval), declares its shard lost.
+    (indices into ``mesh.devices.flat``) — see :func:`probe_devices`."""
+    return probe_devices(list(mesh.devices.flat), deadline_s=deadline_s)
+
+
+def probe_devices(devices, deadline_s: Optional[float] = None) -> List[int]:
+    """Probe a DEVICE LIST (mesh-free — the fleet scheduler health-checks
+    its whole table with this, positions indexing the given list): a
+    probe that raises, or that fails to complete within ``deadline_s``
+    (default: the heartbeat interval), declares its device dead.
 
     Each probe is one scalar ``device_put`` + ``block_until_ready`` — the
     cheapest op that still requires the device runtime to respond. Probes
@@ -88,7 +94,7 @@ def probe_shards(mesh, deadline_s: Optional[float] = None) -> List[int]:
 
     if deadline_s is None:
         deadline_s = shard_heartbeat_s() or DEFAULT_HEARTBEAT_S
-    devices = list(mesh.devices.flat)
+    devices = list(devices)
     dead: List[int] = []
     confirmed = [False] * len(devices)
 

@@ -120,6 +120,10 @@ class RunMonitor:
     #: streaming folds executed inside a cross-session COALESCED device
     #: launch (stacked along a leading session axis, one vmapped program)
     coalesced_folds: int = 0
+    #: streaming folds sharded over a FLEET sub-mesh: per-slice host
+    #: partials fold shard-local states, butterfly-merged at the coalesce
+    #: drain boundary (service.coalesce._execute_mesh_fold)
+    fleet_mesh_folds: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -149,6 +153,7 @@ class RunMonitor:
         self.salvaged_states = 0
         self.fast_path_folds = 0
         self.coalesced_folds = 0
+        self.fleet_mesh_folds = 0
 
     def merge_from(self, other: "RunMonitor") -> None:
         """Absorb another monitor's counters and phase times (locked).
@@ -164,7 +169,7 @@ class RunMonitor:
                 "device_stalls", "device_freq_sets",
                 "freq_overflow_fallbacks", "shard_losses", "mesh_reshards",
                 "salvaged_states", "fast_path_folds", "coalesced_folds",
-                "cost_probes",
+                "fleet_mesh_folds", "cost_probes",
             ):
                 setattr(self, name, getattr(self, name) + getattr(other, name))
             self.bundle_dispatch_seconds += other.bundle_dispatch_seconds
@@ -2283,12 +2288,13 @@ class ScanEngine:
             if program is not None else []
         )
 
-        def compute_partial(index: int, batch) -> Tuple:
+        def compute_partial(index: int, batch, token=None) -> Tuple:
             with _trace.attach(trace_ctx):
                 fault_point("host_partial", tag=str(index))
                 with monitor.timed("host_partials"):
                     ctx = HostBatchContext(
-                        batch, batch_index=index, run_token=run_token
+                        batch, batch_index=index,
+                        run_token=token if token is not None else run_token,
                     )
                     return tuple(a.host_partial(ctx) for a in analyzers)
 
@@ -2458,6 +2464,16 @@ class ScanEngine:
                     "replaying %d batches lost with dead mesh shards",
                     len(todo),
                 )
+                # a FRESH memo token per replay round: the pass token's
+                # cross-batch skip (the HLL dictionary memo) may have
+                # credited an entry to a batch the DEAD shard owned —
+                # replaying that batch under the old token would skip the
+                # entry and silently undercount. Within one round the
+                # fresh token may share (the first replayed batch that
+                # sees an entry re-contributes it into a SURVIVING
+                # shard); a loss during replay starts another round with
+                # another fresh token.
+                replay_token = object()
                 replay_buf: List[Tuple] = []
                 replay_idx: List[int] = []
 
@@ -2483,7 +2499,9 @@ class ScanEngine:
                         break  # replay cost scales with len(todo), not rows
                     if index not in todo:
                         continue
-                    replay_buf.append(compute_partial(index, batch))
+                    replay_buf.append(
+                        compute_partial(index, batch, token=replay_token)
+                    )
                     replay_idx.append(index)
                     if len(replay_buf) == chunk:
                         flush_replay(chunk)

@@ -12,6 +12,11 @@ One process-wide :class:`VerificationService` hosts:
 - **cache-aware placement** (`placement.PlacementRouter`): warm fused
   batteries run on the device tier, cold ones fall back to the host tier
   while the device program compiles in the background;
+- a **fleet scheduler** (`fleet.FleetScheduler`): on a multi-chip
+  accelerator, every tenant's scans shard across that tenant's DISJOINT
+  sub-mesh slice of the device mesh by default, with elastic re-packing
+  over the survivors when a shard dies (DEEQU_TPU_FLEET=0 restores
+  single-chip routing byte-for-byte);
 - an **export plane** (`metrics.ServiceMetrics` / `MetricsExporter`):
   Prometheus-text and JSON snapshots of per-phase timings, queue depth,
   retry/shed counts and cache hit rates, fed from each run's RunMonitor.
@@ -49,6 +54,13 @@ from .errors import (
 from ..exceptions import SchemaDriftError
 from .coalesce import CrossoverRouter, FoldCoalescer
 from .drift import DriftReport, SchemaContract
+from .fleet import (
+    FLEET_ENV,
+    FleetScheduler,
+    SubMeshLease,
+    fleet_enabled,
+    mesh_substrate,
+)
 from .metrics import MetricsExporter, ServiceMetrics
 from .placement import (
     PlacementRouter,
@@ -65,6 +77,8 @@ __all__ = [
     "PlacementRouter", "battery_signature", "shape_qualified_signature",
     "ServiceMetrics", "MetricsExporter",
     "FoldCoalescer", "CrossoverRouter",
+    "FleetScheduler", "SubMeshLease", "fleet_enabled", "mesh_substrate",
+    "FLEET_ENV",
     "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
     "TransientFailure", "SessionClosed", "ServiceClosed",
     "SchemaContract", "DriftReport", "SchemaDriftError",
@@ -83,16 +97,31 @@ class VerificationService:
         mesh=None,
         background_warm: bool = True,
         metrics: Optional[ServiceMetrics] = None,
+        fleet: Optional[bool] = None,
     ):
         self.metrics = metrics or ServiceMetrics()
         self.router = PlacementRouter(
             self.metrics, mesh=mesh, background_warm=background_warm
         )
+        # fleet scheduling: with no EXPLICIT mesh and a multi-device
+        # accelerator (or DEEQU_TPU_FLEET=1 forcing the virtual-device
+        # fallback), every tenant's scans shard across its own disjoint
+        # sub-mesh by default. ``fleet=False`` (or DEEQU_TPU_FLEET=0)
+        # restores single-chip routing byte-for-byte; an explicit
+        # ``mesh=`` keeps the legacy one-global-mesh behavior unchanged.
+        from .fleet import FleetScheduler, fleet_enabled
+
+        self.fleet = None
+        if mesh is None and (
+            fleet if fleet is not None else fleet_enabled()
+        ):
+            self.fleet = FleetScheduler(self.metrics)
         self.scheduler = JobScheduler(
             workers=workers,
             max_queue_depth=max_queue_depth,
             metrics=self.metrics,
             router=self.router,
+            fleet=self.fleet,
         )
         self.state_root = state_root
         self.mesh = mesh
@@ -153,7 +182,11 @@ class VerificationService:
                 save_or_append_results_with_key=save_or_append_results_with_key,
                 batch_size=effective_bs,
                 monitor=ctx.monitor,
-                sharding=self.mesh,
+                # fleet default path: the tenant's leased sub-mesh shards
+                # this scan's row stream; an explicit service mesh keeps
+                # the legacy one-global-mesh behavior; neither -> single
+                # chip (the escape-hatch path, byte-for-byte)
+                sharding=ctx.mesh if ctx.mesh is not None else self.mesh,
                 placement=ctx.placement,
             )
 
@@ -167,9 +200,18 @@ class VerificationService:
         # size, so the warmth key can never drift from the dispatched
         # shape.
         effective_bs = _session_batch_size(int(data.num_rows), batch_size)
-        signature = shape_qualified_signature(analyzers, effective_bs)
+        # warmth is claimed per MESH SHAPE too: under the fleet the
+        # expected slice for this tenant qualifies the key (and the warm
+        # compiles for that exact slice), so a re-packed tenant reads
+        # cold at its new shape instead of reusing a mismatched program
+        warm_mesh = (
+            self.fleet.peek(tenant) if self.fleet is not None else self.mesh
+        )
+        signature = shape_qualified_signature(
+            analyzers, effective_bs, warm_mesh
+        )
         warm = make_warm_fn(
-            self.router, analyzers, self.mesh, data, effective_bs
+            self.router, analyzers, warm_mesh, data, effective_bs
         )
         return self.scheduler.submit(
             run,
@@ -180,6 +222,7 @@ class VerificationService:
             retry_on=retry_on,
             signature=signature,
             warm_fn=warm,
+            mesh_tenant=tenant if self.fleet is not None else None,
         )
 
     def verify(self, data: Dataset, checks: Sequence[Check], **kw):
@@ -296,6 +339,8 @@ class VerificationService:
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
+        if self.fleet is not None:
+            self.fleet.close()
 
     def __enter__(self) -> "VerificationService":
         return self

@@ -62,6 +62,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 _logger = logging.getLogger(__name__)
 
 #: "0" disables coalescing AND the fast path entirely (exact escape hatch)
@@ -198,12 +200,18 @@ class FoldPlan:
     precondition failures, feature-validation failures — everything whose
     degradation semantics live in the full runner)."""
 
-    __slots__ = ("battery", "columns", "fast_ok", "signatures", "_builder")
+    __slots__ = (
+        "battery", "columns", "fast_ok", "mesh_ok", "signatures", "_builder",
+    )
 
-    def __init__(self, battery, columns, fast_ok, signatures):
+    def __init__(self, battery, columns, fast_ok, signatures, mesh_ok=False):
         self.battery = battery
         self.columns = columns
         self.fast_ok = fast_ok
+        #: may this battery's folds shard over a fleet sub-mesh? Requires
+        #: host partials (the shard-local fold feeds `sharded_ingest_fold`
+        #: with per-slice partial states)
+        self.mesh_ok = mesh_ok
         self.signatures = signatures
         self._builder = None
 
@@ -276,10 +284,17 @@ def build_fold_plan(analyzers, schema) -> Optional[FoldPlan]:
         )
         for a in battery
     )
+    # the fleet's shard-local stream fold computes per-slice HOST partials
+    # and folds them over the sub-mesh — any host-partial-capable battery
+    # qualifies (identity-merge transparency is NOT required: the
+    # butterfly merge is the same semigroup merge the engine's host tier
+    # already runs under a mesh)
+    mesh_ok = all(a.supports_host_partial for a in battery)
     battery = tuple(battery)
     return FoldPlan(
         battery, columns, fast_ok,
         tuple(_scan_signature(a) for a in battery),
+        mesh_ok=mesh_ok,
     )
 
 
@@ -415,6 +430,12 @@ class FoldCoalescer:
             "Folds isolated to a typed failure by coalesced-launch "
             "bisection while their group siblings committed.",
         )
+        m.describe(
+            "deequ_service_fleet_stream_folds_total",
+            "Streaming folds sharded over a fleet sub-mesh (shard-local "
+            "states, butterfly merge at the drain boundary), labeled by "
+            "tenant and slice device count.",
+        )
 
     # -- ingest-side API -----------------------------------------------------
 
@@ -447,6 +468,15 @@ class FoldCoalescer:
             )
             return None
         route = self.router.route(plan, rows)
+        if route == "fast" and self._fleet_stream_eligible(
+            plan, rows, tenant=session.tenant
+        ):
+            # the fleet's sharding contract outranks the crossover model:
+            # a delta at/above DEEQU_TPU_FLEET_STREAM_MIN_ROWS must reach
+            # the mesh drain path (which lives on the device route), or
+            # the knob would be unreachable for exactly the fast-capable
+            # batteries it was documented for
+            route = "device"
         key = (route,) + plan.signatures + (bucket,)
         pending = _PendingFold(
             session, data, bucket, plan, route, key, drainable
@@ -986,10 +1016,53 @@ class FoldCoalescer:
             cache[key] = batch
         return batch
 
+    def _fleet_stream_eligible(
+        self, plan, rows: int, tenant: Optional[str] = None
+    ) -> bool:
+        """Would a fold of this battery at this size shard over a fleet
+        sub-mesh? (The routing half of `_fleet_lease`; the lease itself
+        happens at drain time.) With ``tenant``, also requires the
+        CURRENT packing to grant that tenant a multi-device slice — a
+        fast-routed fold must not be flipped onto the device route for a
+        single-chip slice the drain would never shard anyway (the
+        crossover router measured fast as the winner there). A re-pack
+        between this peek and the drain can still leave a rare flipped
+        fold on the single-chip stack; that costs one launch, never
+        correctness."""
+        fleet = getattr(self.service, "fleet", None)
+        if fleet is None or plan is None or not plan.mesh_ok:
+            return False
+        from .fleet import fleet_stream_min_rows
+
+        if int(rows) < fleet_stream_min_rows():
+            return False
+        return tenant is None or fleet.peek(tenant).n_dev >= 2
+
+    def _fleet_lease(self, f: _PendingFold):
+        """Acquire the tenant's sub-mesh lease for a fleet-eligible fold,
+        or None (no fleet, battery not host-partial-capable, delta below
+        the sharding floor, or a single-chip slice). The caller must
+        release a non-None lease."""
+        fleet = getattr(self.service, "fleet", None)
+        if fleet is None or not f.plan.mesh_ok:
+            return None
+        from .fleet import fleet_stream_min_rows
+
+        if int(f.data.num_rows) < fleet_stream_min_rows():
+            return None
+        lease = fleet.acquire(f.skey[0])
+        if lease.n_dev < 2:
+            fleet.release(f.skey[0])
+            return None
+        return lease
+
     def _execute_device(self, group: List[_PendingFold]) -> None:
         """Guard + stage every fold, then launch the group as one vmapped
         program; bisect on launch failure so a fault inside the joint
-        launch quarantines only the owning session(s)."""
+        launch quarantines only the owning session(s). Fleet-sized folds
+        peel off first: each shards over its tenant's sub-mesh (shard-
+        local states, butterfly merge at this drain boundary) instead of
+        joining the single-chip stack."""
         from ..reliability.faults import fault_point
 
         prepped = []
@@ -997,6 +1070,20 @@ class FoldCoalescer:
             try:
                 if f.state == _DONE:
                     continue  # claim-wait backstop resolved it
+                lease = self._fleet_lease(f)
+                if lease is not None:
+                    fleet = self.service.fleet
+                    try:
+                        result, error = self._execute_mesh_fold(f, lease)
+                    finally:
+                        fleet.release(f.skey[0])
+                        if f.monitor.shard_losses:
+                            # the fold survived via the ladder; make the
+                            # NEXT lease pack over the survivors
+                            fleet.note_shard_loss()
+                    if f.state != _DONE:
+                        self._complete(f, result=result, error=error)
+                    continue
                 degraded = False
                 fault_point("stream_fold", tag=_job_tag(f))
                 with f.session._serial:
@@ -1026,6 +1113,152 @@ class FoldCoalescer:
                     raise
         if prepped:
             self._launch_bisect(prepped)
+
+    def _execute_mesh_fold(self, f: _PendingFold, lease):
+        """One streaming fold sharded over the tenant's sub-mesh: the
+        micro-batch row-splits into one slice per device, each slice's
+        HOST partial folds into that shard's LOCAL state
+        (`sharded_ingest_fold` through the `ElasticMeshFold` ladder, so a
+        shard lost mid-fold salvages + re-shards exactly like a batch
+        scan), and the per-shard states butterfly-merge on the ICI at
+        THIS drain boundary (`collective_merge_states` inside
+        ``finish()``) into the delta the session's persisted states
+        absorb. Metrics/checks/drift semantics are the serial path's own
+        (same `_pre_fold`/`_finalize`/`_commit_fold` machinery)."""
+        import math
+
+        from ..analyzers.base import HostBatchContext
+        from ..parallel import ElasticMeshFold
+        from ..reliability.faults import fault_point
+
+        session = f.session
+        mon = f.monitor
+        mesh = lease.mesh
+        n_dev = lease.n_dev
+        sharded = True
+        try:
+            fault_point("stream_fold", tag=_job_tag(f))
+            fault_point(
+                "coalesced_fold", tag=f"{f.skey[0]}/{f.skey[1]}"
+            )
+            with session._serial:
+                if session._closed:
+                    from .errors import SessionClosed
+
+                    raise SessionClosed(*f.skey)
+                data, pending_contract, degraded = session._pre_fold(f.data)
+                if degraded:
+                    # drift-degraded columns: only the full runner's
+                    # per-analyzer degradation can honor this fold
+                    sharded = False
+                    self._serial_fallback(f, data, pending_contract)
+                else:
+                    battery = f.plan.battery
+                    rows = int(data.num_rows)
+                    slice_rows = max(1, math.ceil(rows / n_dev))
+                    elastic = ElasticMeshFold(battery, mesh, monitor=mon)
+
+                    def slice_partials(wanted=None):
+                        # one FRESH memo token per invocation (the
+                        # engine's replay-round discipline): slices of
+                        # one round may share per-pass memo work (the
+                        # HLL dictionary skip — the first slice that
+                        # sees an entry contributes it), but a REPLAY
+                        # round must never skip an entry whose only
+                        # contribution died with the lost shard
+                        run_token = object()
+                        out = []
+                        with mon.timed("host_partials"):
+                            for i, batch in enumerate(data.batches(
+                                slice_rows, columns=f.plan.columns,
+                                pad_to_batch_size=False,
+                            )):
+                                if wanted is not None and i not in wanted:
+                                    continue
+                                ctx = HostBatchContext(
+                                    batch, batch_index=i,
+                                    run_token=run_token,
+                                )
+                                out.append((i, tuple(
+                                    a.host_partial(ctx) for a in battery
+                                )))
+                        return out
+
+                    def fold_slices(slices):
+                        import jax as _jax
+
+                        group = [p for _, p in slices]
+                        idx = [i for i, _ in slices]
+                        if len(group) < n_dev:
+                            # pad with identity partials (an empty batch's
+                            # partial) so ONE compiled fold shape serves
+                            # every delta size; flags skip the padding
+                            from ..runners.engine import _empty_batch_like
+
+                            ident = tuple(
+                                a.host_partial(HostBatchContext(
+                                    _empty_batch_like(data, f.plan.columns),
+                                    batch_index=len(group),
+                                ))
+                                for a in battery
+                            )
+                            group = group + [ident] * (n_dev - len(group))
+                        flags = np.zeros(len(group), dtype=bool)
+                        flags[: len(idx)] = True
+                        stacked = tuple(
+                            _jax.tree_util.tree_map(
+                                lambda *xs: np.stack(
+                                    [np.asarray(x) for x in xs]
+                                ),
+                                *[p[i] for p in group],
+                            )
+                            for i in range(len(battery))
+                        )
+                        with mon.timed("ingest_fold"):
+                            elastic.fold(stacked, flags, batch_indices=idx)
+
+                    fold_slices(slice_partials())
+                    # the drain-boundary butterfly: per-shard states merge
+                    # on the ICI into ONE canonical delta per analyzer. A
+                    # shard lost mid-fold (or DURING the merge itself)
+                    # queues its slices for replay: recompute exactly
+                    # those, re-fold on the rebuilt mesh, and re-merge —
+                    # loop until a merge completes with nothing pending
+                    # (the engine's own replay->finish discipline)
+                    while True:
+                        while elastic.pending_replay:
+                            todo = set(elastic.take_lost_batches())
+                            fold_slices(slice_partials(wanted=todo))
+                        with mon.timed("ingest_fold"):
+                            states = elastic.finish()
+                        if not elastic.pending_replay:
+                            break
+                    result = self._finalize_states(f, states)
+                    mon.bump("passes")
+                    mon.bump("batches")
+                    mon.bump("device_updates")
+                    mon.bump("fleet_mesh_folds")
+                    # "mesh", NOT "device": note_ran treats an executed
+                    # placement of "device" as warmth evidence for the
+                    # single-chip fused program, which this path never
+                    # compiles (it runs host partials + collectives) —
+                    # claiming it would send a later small fold of the
+                    # same battery straight into the cold compile
+                    mon.placement = "mesh"
+                    session._commit_fold(
+                        result, data, pending_contract, f.done
+                    )
+            result = session._notify(f.done)
+            if sharded:
+                self.service.metrics.inc(
+                    "deequ_service_fleet_stream_folds_total",
+                    tenant=f.skey[0], devices=str(n_dev),
+                )
+            return result, None
+        except BaseException as exc:
+            if not isinstance(exc, Exception):
+                raise
+            return None, exc
 
     def _launch_bisect(self, prepped) -> None:
         from ..observability import trace as _trace

@@ -1,0 +1,516 @@
+"""Fleet scheduler: tenants packed onto disjoint sub-meshes by default.
+
+Everything in this repo's state algebra is mergeable by construction, and
+PR 7 made the multi-chip scan SURVIVE shard loss — but until this module,
+production traffic still landed on one chip: a `VerificationService` built
+without an explicit ``mesh=`` never sharded anything. This module closes
+ROADMAP item 2's promotion: the mesh becomes the default service path.
+
+- **Default-on sharding.** When the process sees a multi-device
+  accelerator mesh, every batch verification job's row stream shards
+  across it by default (naive leading-axis batch sharding with replicated
+  small states — the one-axis data-mesh pattern of SNIPPETS [2], executed
+  through the existing pjit'd explicit-sharding programs of [1]/[3] and
+  the host-partial `sharded_ingest_fold`), riding the `ElasticMeshFold`
+  ladder for loss recovery. ``DEEQU_TPU_FLEET=0`` is the escape hatch:
+  single-chip routing, byte-for-byte the pre-fleet path.
+
+- **Disjoint sub-mesh packing.** Independent tenants do not share chips:
+  the :class:`FleetScheduler` partitions the healthy device set into
+  power-of-two slices (8 -> 4+4 for two tenants, 2-device slices for
+  three or four, single chips beyond) and leases each tenant its own
+  slice, so one tenant's scan cannot contend with another's — the
+  acceptance property the multi-tenant soak measures. More tenants than
+  chips wrap around (slices shared round-robin, still bounded).
+
+- **Elastic re-packing.** A shard dropping out of the ladder (dead
+  collective, heartbeat miss, injected ``mesh_loss``) marks its device
+  unhealthy fleet-wide — the elastic layer's loss notification feeds
+  :meth:`FleetScheduler.note_shard_loss` — and the next lease packs
+  tenants over the survivors. In-flight jobs keep recovering through
+  their own ladder; future jobs never see the dead chip.
+
+Warmth interplay: warmth keys are MESH-SHAPE-QUALIFIED
+(`placement.shape_qualified_signature` carries the device count), so a
+battery warmed for an 8-device program is COLD for the 4-device sub-mesh
+a re-pack hands the tenant — it recompiles (cheaply, via the persistent
+XLA cache) instead of silently reusing a program whose collective layout
+no longer matches the mesh.
+
+Default policy: the fleet is ON when the backend is a real accelerator
+with more than one chip. On the CPU backend it must be FORCED with
+``DEEQU_TPU_FLEET=1`` — virtual CPU "devices" share the same host cores
+(the r06 ``vs_baseline: 0.8`` lesson), so sharding over them models
+nothing and slows the host paths that actually serve CPU-only boxes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_logger = logging.getLogger(__name__)
+
+#: env var: "0" disables the fleet scheduler entirely (single-chip routing,
+#: byte-for-byte the pre-fleet service path); "1" forces it on even on the
+#: CPU backend (tests / virtual-device drills); unset = on iff the backend
+#: is a real accelerator with >1 device.
+FLEET_ENV = "DEEQU_TPU_FLEET"
+
+#: env var: minimum micro-batch rows before a STREAMING fold shards over
+#: the tenant's sub-mesh (default 65536). Below it the single-chip
+#: coalesced/fast paths win outright — sharding a 4096-row delta over the
+#: ICI costs more in collective latency than the fold itself.
+FLEET_STREAM_MIN_ROWS_ENV = "DEEQU_TPU_FLEET_STREAM_MIN_ROWS"
+DEFAULT_FLEET_STREAM_MIN_ROWS = 1 << 16
+
+
+_FLEET_ENV_WARNED = False
+
+
+def fleet_enabled() -> bool:
+    """Whether the fleet scheduler should run in this process (see module
+    docstring for the default policy). Follows the warn-and-fallback
+    convention: any value other than "0"/"1" warns once and keeps the
+    default policy."""
+    global _FLEET_ENV_WARNED
+    raw = os.environ.get(FLEET_ENV)
+    if raw is not None:
+        value = raw.strip()
+        if value == "0":
+            return False
+        if value == "1":
+            import jax
+
+            if len(jax.devices()) > 1:
+                return True
+            if not _FLEET_ENV_WARNED:
+                _FLEET_ENV_WARNED = True
+                _logger.warning(
+                    "%s=1 but only one device is visible — the fleet "
+                    "stays off (for a CPU drill also set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N)",
+                    FLEET_ENV,
+                )
+            return False
+        if not _FLEET_ENV_WARNED:
+            _FLEET_ENV_WARNED = True
+            _logger.warning(
+                "ignoring invalid %s=%r (expected \"0\" or \"1\"); "
+                "keeping the default accelerator-only policy",
+                FLEET_ENV, raw,
+            )
+    import jax
+
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 - no backend -> no fleet
+        return False
+    return len(devices) > 1 and jax.default_backend() != "cpu"
+
+
+def fleet_stream_min_rows() -> int:
+    from ..utils import env_number
+
+    return env_number(
+        FLEET_STREAM_MIN_ROWS_ENV, DEFAULT_FLEET_STREAM_MIN_ROWS, int,
+        minimum=0,
+    )
+
+
+def mesh_substrate() -> Dict[str, Any]:
+    """What the mesh is MADE OF — recorded beside every scaling number so
+    a CPU-virtual-device point can never be misread as an accelerator
+    point (the r06 ``vs_baseline: 0.8`` lesson, satellite of ISSUE 12)."""
+    import jax
+
+    devices = jax.devices()
+    backend = jax.default_backend()
+    return {
+        "substrate": "accelerator" if backend != "cpu" else "cpu-virtual",
+        "backend": backend,
+        "device_kind": devices[0].device_kind if devices else "none",
+        "chip_count": len(devices),
+    }
+
+
+class SubMeshLease:
+    """One tenant's grant of a device slice: the positions (indices into
+    the fleet's device table), the device objects, and the packing
+    generation it was cut from. ``mesh`` builds lazily and is shared per
+    device tuple fleet-wide, so two leases of the same slice reuse one
+    ``jax.sharding.Mesh`` (and therefore one compiled-program cache
+    line)."""
+
+    __slots__ = ("tenant", "positions", "devices", "generation", "_fleet")
+
+    def __init__(self, tenant, positions, devices, generation, fleet):
+        self.tenant = tenant
+        self.positions: Tuple[int, ...] = tuple(positions)
+        self.devices = tuple(devices)
+        self.generation = int(generation)
+        self._fleet = fleet
+
+    @property
+    def n_dev(self) -> int:
+        return len(self.devices)
+
+    @property
+    def mesh(self):
+        """The slice's 1-D row mesh, or None for a single-chip slice (a
+        1-device mesh would engage the quantum/collective machinery for
+        no benefit — single chip IS the escape-hatch path)."""
+        if self.n_dev < 2:
+            return None
+        return self._fleet._mesh_for(self.devices)
+
+    def __repr__(self) -> str:  # lease lines show up in trace events
+        return (
+            f"SubMeshLease({self.tenant!r}, devices={self.positions}, "
+            f"gen={self.generation})"
+        )
+
+
+class FleetScheduler:
+    """The device-mesh packing plane of the service.
+
+    Thread-safe; every public method takes the internal lock. Packing is
+    recomputed whenever the ACTIVE tenant set or the healthy device set
+    changes: slice size = the largest power of two that gives every
+    active tenant its own slice (floor 1), tenants assigned to slices in
+    arrival order, wrapping when tenants outnumber slices. Leases are
+    refcounted per tenant — a tenant leaves the active set (and frees its
+    slice for re-packing) when its last concurrent job releases."""
+
+    def __init__(self, metrics=None, devices: Optional[Sequence] = None):
+        import jax
+
+        from .metrics import ServiceMetrics
+
+        self.metrics = metrics or ServiceMetrics()
+        self._lock = threading.Lock()
+        #: the full device table, fixed at construction (positions in every
+        #: lease / loss report index into it)
+        self._devices: List[Any] = list(
+            devices if devices is not None else jax.devices()
+        )
+        #: positions still believed healthy (losses remove, never re-add —
+        #: a chip that dropped off the ICI does not quietly come back; an
+        #: operator restarts the service to reclaim it)
+        self._healthy: List[int] = list(range(len(self._devices)))
+        #: active tenants in arrival order (the packing order)
+        self._members: List[str] = []
+        self._refs: Dict[str, int] = {}
+        #: tenant -> monotonic time of its last acquire/release: what the
+        #: idle-TTL reclamation in _pack_locked reads
+        self._last_seen: Dict[str, float] = {}
+        #: tenant -> healthy positions of its current slice
+        self._assignment: Dict[str, List[int]] = {}
+        self._generation = 0
+        self.repacks = 0
+        #: one Mesh per device tuple: program caches key on the exact
+        #: device tuple, so reusing the Mesh object keeps warm programs
+        #: warm across leases of the same slice
+        self._meshes: Dict[Tuple, Any] = {}
+        m = self.metrics
+        m.describe(
+            "deequ_service_fleet_leases_total",
+            "Sub-mesh leases granted to tenant jobs by the fleet "
+            "scheduler, labeled by slice device count.",
+        )
+        m.describe(
+            "deequ_service_fleet_repacks_total",
+            "Fleet re-packings (tenant membership change or shard loss "
+            "re-pack over the surviving devices).",
+        )
+        m.describe(
+            "deequ_service_fleet_shard_losses_total",
+            "Devices marked unhealthy fleet-wide after a shard dropped "
+            "out of the elastic ladder.",
+        )
+        m.set_gauge_fn(
+            "deequ_service_fleet_healthy_devices",
+            lambda: len(self._healthy),
+            "Devices the fleet scheduler still packs tenants onto.",
+        )
+        m.set_gauge_fn(
+            "deequ_service_fleet_active_tenants",
+            lambda: len(self._members),
+            "Tenants currently holding at least one sub-mesh lease.",
+        )
+        # the elastic layer names lost devices the moment a ladder walk
+        # salvages them — subscribe so re-packing does not wait for the
+        # scheduler's post-job harvest. Weakly: a torn-down service's
+        # fleet must unhook itself instead of living forever in the
+        # listener list (and mis-marking devices for a successor fleet)
+        import weakref
+
+        from ..parallel.elastic import (
+            add_shard_loss_listener,
+            remove_shard_loss_listener,
+        )
+
+        ref = weakref.ref(self)
+
+        def _listener(devices, _ref=ref):
+            fleet = _ref()
+            if fleet is None:
+                remove_shard_loss_listener(_listener)
+                return
+            fleet._on_elastic_loss(devices)
+
+        self._listener = _listener
+        add_shard_loss_listener(_listener)
+
+    def close(self) -> None:
+        from ..parallel.elastic import remove_shard_loss_listener
+
+        remove_shard_loss_listener(self._listener)
+
+    # -- packing -------------------------------------------------------------
+
+    def _mesh_for(self, devices: Tuple):
+        with self._lock:
+            mesh = self._meshes.get(devices)
+            if mesh is None:
+                from ..parallel import make_mesh
+
+                mesh = make_mesh(devices=list(devices))
+                self._meshes[devices] = mesh
+            return mesh
+
+    @staticmethod
+    def _slice_size(n_healthy: int, n_tenants: int) -> int:
+        if n_healthy <= 0:
+            return 0
+        per = max(1, n_healthy // max(1, n_tenants))
+        size = 1
+        while size * 2 <= per:
+            size *= 2
+        return size
+
+    def _cut_slices_locked(self, n_tenants: int) -> List[List[int]]:
+        """The slice partition a packing over ``n_tenants`` would cut
+        from the current healthy set (under the lock). ONE function
+        serves both the real packing and peek's prediction, so the two
+        can never disagree about slice geometry."""
+        healthy = list(self._healthy)
+        size = self._slice_size(len(healthy), n_tenants)
+        if not size:
+            return []
+        return [
+            healthy[i: i + size]
+            for i in range(0, len(healthy) - size + 1, size)
+        ]
+
+    def _pack_locked(self) -> None:
+        """Recompute the tenant -> slice assignment (under the lock).
+
+        Transition semantics: re-packing changes FUTURE leases only —
+        a job already running on its leased slice keeps it, so for the
+        remainder of that job a newly-arrived tenant's slice can overlap
+        the old packing's devices. Disjointness is a steady-state
+        guarantee (and what the drills assert); making arrivals wait for
+        every in-flight lease to drain would park new tenants behind
+        arbitrarily long scans."""
+        import time
+
+        self._generation += 1
+        self.repacks += 1
+        # EVERY re-pack reaches the export plane (membership growth and
+        # loss re-packs alike): ServiceMetrics has its own lock and never
+        # calls back into the fleet, so this nesting cannot invert
+        self.metrics.inc("deequ_service_fleet_repacks_total")
+        # membership is sticky between jobs (a streaming tenant's refs
+        # drop to zero between every fold — pruning on bare zero-ref
+        # would evict LIVE tenants and collapse disjointness for
+        # sequential workloads), so reclaim only tenants idle past the
+        # TTL, and only when the packing changes anyway: a departed
+        # tenant shrinks the others' slices at most until the next
+        # natural re-pack after IDLE_TTL_S
+        cutoff = time.monotonic() - self.IDLE_TTL_S
+        self._members = [
+            t for t in self._members
+            if self._refs.get(t, 0) > 0
+            or self._last_seen.get(t, cutoff) > cutoff
+        ]
+        # _last_seen entries for pruned tenants go with them: a standing
+        # service seeing a new one-off tenant name per dataset must not
+        # grow this map one float per name forever
+        keep = set(self._members) | {
+            t for t, n in self._refs.items() if n > 0
+        }
+        self._last_seen = {
+            t: v for t, v in self._last_seen.items() if t in keep
+        }
+        self._assignment = {}
+        slices = self._cut_slices_locked(len(self._members))
+        if not slices:
+            return
+        for i, tenant in enumerate(self._members):
+            self._assignment[tenant] = slices[i % len(slices)]
+
+    def _lease_locked(self, tenant: str) -> SubMeshLease:
+        positions = self._assignment.get(tenant, self._healthy[:1])
+        return SubMeshLease(
+            tenant, positions,
+            [self._devices[p] for p in positions],
+            self._generation, self,
+        )
+
+    # -- tenant-facing API ---------------------------------------------------
+
+    #: seconds a zero-ref tenant survives in the packing before a
+    #: re-pack may reclaim its slice (long enough that a streaming
+    #: tenant's between-fold gaps never count as departure)
+    IDLE_TTL_S = 300.0
+
+    def acquire(self, tenant: str) -> SubMeshLease:
+        """Lease the tenant's sub-mesh for one job/drain; pair with
+        :meth:`release`. First lease of an unseen tenant re-packs."""
+        import time
+
+        with self._lock:
+            self._refs[tenant] = self._refs.get(tenant, 0) + 1
+            self._last_seen[tenant] = time.monotonic()
+            if tenant not in self._assignment:
+                if tenant not in self._members:
+                    self._members.append(tenant)
+                self._pack_locked()
+            lease = self._lease_locked(tenant)
+        self.metrics.inc(
+            "deequ_service_fleet_leases_total", devices=str(lease.n_dev)
+        )
+        return lease
+
+    def release(self, tenant: str) -> None:
+        """Release one lease. Membership is STICKY: a tenant keeps its
+        slice between jobs (streaming drains lease per sweep — re-packing
+        on every release would oscillate slice sizes and churn compiled
+        mesh shapes), so re-packs happen only on membership GROWTH and on
+        shard loss. :meth:`evict_idle` reclaims slices of tenants that
+        stopped submitting."""
+        import time
+
+        with self._lock:
+            self._last_seen[tenant] = time.monotonic()
+            n = self._refs.get(tenant, 0) - 1
+            if n > 0:
+                self._refs[tenant] = n
+            else:
+                self._refs.pop(tenant, None)
+
+    def evict_idle(self) -> int:
+        """Drop zero-ref tenants from the packing and re-pack NOW (an
+        operator/maintenance hook). The hot paths reclaim lazily instead:
+        `_pack_locked` prunes zero-ref members whenever a membership
+        change or shard loss re-packs anyway, so a departed tenant can
+        shrink the others' slices only until the next natural re-pack.
+        Returns how many tenants were evicted."""
+        with self._lock:
+            idle = [t for t in self._members if self._refs.get(t, 0) <= 0]
+            for t in idle:
+                self._members.remove(t)
+                self._assignment.pop(t, None)
+                self._last_seen.pop(t, None)
+            if idle:
+                self._pack_locked()
+            return len(idle)
+
+    def peek(self, tenant: str) -> SubMeshLease:
+        """The slice the CURRENT packing would grant this tenant, without
+        taking a lease (submit-time warmth keys and warm closures use it;
+        the pickup-time lease may differ if the fleet re-packed in
+        between — warmth is advisory, so the cost is one background
+        compile, never wrong reuse)."""
+        with self._lock:
+            if tenant in self._assignment:
+                return self._lease_locked(tenant)
+            # predict the EXACT slice _pack_locked would grant with this
+            # tenant joined: same size rule, same arrival-order slot
+            # (len(members) is the new tenant's index). Predicting
+            # healthy[:size] instead would warm a pjit program for the
+            # FIRST slice while acquire packs every non-first tenant
+            # onto a different one — a deterministically wasted warm
+            # plus a cold compile on the device tier
+            slices = self._cut_slices_locked(len(self._members) + 1)
+            positions: Sequence[int] = []
+            if slices:
+                positions = slices[len(self._members) % len(slices)]
+            return SubMeshLease(
+                tenant, positions,
+                [self._devices[p] for p in positions],
+                self._generation, self,
+            )
+
+    def devices_of(self, tenant: str) -> Tuple[int, ...]:
+        """Healthy positions currently assigned to the tenant (tests use
+        this to assert disjointness)."""
+        with self._lock:
+            return tuple(self._assignment.get(tenant, ()))
+
+    # -- elasticity ----------------------------------------------------------
+
+    def _on_elastic_loss(self, lost_devices: Sequence) -> None:
+        """ElasticMeshFold salvage named these device objects lost."""
+        positions = [
+            i for i, d in enumerate(self._devices) if d in tuple(lost_devices)
+        ]
+        if positions:
+            self.mark_unhealthy(positions)
+
+    def mark_unhealthy(self, positions: Sequence[int]) -> None:
+        dropped = []
+        with self._lock:
+            for p in positions:
+                if p in self._healthy:
+                    self._healthy.remove(p)
+                    dropped.append(p)
+            if dropped:
+                self._pack_locked()
+        if dropped:
+            from ..observability import trace as _trace
+
+            self.metrics.inc(
+                "deequ_service_fleet_shard_losses_total", float(len(dropped))
+            )
+            _trace.add_event(
+                "fleet_repack", dropped=dropped,
+                healthy=len(self._healthy), tenants=len(self._members),
+            )
+            _logger.warning(
+                "fleet re-pack: devices %s marked unhealthy, %d healthy "
+                "remain, %d tenants re-packed",
+                dropped, len(self._healthy), len(self._members),
+            )
+
+    def note_shard_loss(self) -> None:
+        """A job's monitor reported shard losses without naming devices
+        (pass-level GSPMD failures): probe the full device table and drop
+        whatever fails to answer. The elastic listener path usually beat
+        us here; probing again is cheap and idempotent."""
+        from ..parallel.health import probe_devices
+
+        with self._lock:
+            candidates = [(p, self._devices[p]) for p in self._healthy]
+        if len(candidates) < 2:
+            return
+        dead = probe_devices([d for _, d in candidates])
+        if dead:
+            self.mark_unhealthy([candidates[i][0] for i in dead])
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "healthy": list(self._healthy),
+                "tenants": list(self._members),
+                "assignment": {
+                    t: list(p) for t, p in self._assignment.items()
+                },
+                "generation": self._generation,
+                "repacks": self.repacks,
+            }

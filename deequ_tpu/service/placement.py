@@ -50,20 +50,45 @@ def battery_signature(analyzers: Sequence[Analyzer]) -> Signature:
     return _deduped_battery(analyzers)
 
 
+def _mesh_devices(mesh) -> int:
+    """Device count of a mesh-ish value: a ``jax.sharding.Mesh``, a
+    :class:`~deequ_tpu.service.fleet.SubMeshLease`, an int, or None."""
+    if mesh is None:
+        return 1
+    if isinstance(mesh, int):
+        return mesh
+    n = getattr(mesh, "n_dev", None)
+    if n is not None:
+        return int(n)
+    devices = getattr(mesh, "devices", None)
+    return int(devices.size) if devices is not None else 1
+
+
 def shape_qualified_signature(
-    analyzers: Sequence[Analyzer], batch_size: int
+    analyzers: Sequence[Analyzer], batch_size: int, mesh=None
 ) -> Tuple:
-    """``battery_signature`` plus the padded batch size. jit compiles per
-    SHAPE, so warmth must be claimed per (battery, batch size): a battery
-    warm at one shape still cold-compiles at another, and routing it to
-    the device tier would stall a worker on exactly the compile the router
-    exists to keep off the queue. An EMPTY battery (grouping/host-only
+    """``battery_signature`` plus the padded batch size, plus — for
+    multi-device runs — the MESH SHAPE. jit compiles per SHAPE, so warmth
+    must be claimed per (battery, batch size): a battery warm at one
+    shape still cold-compiles at another, and routing it to the device
+    tier would stall a worker on exactly the compile the router exists to
+    keep off the queue. The mesh qualifier closes the same hole one level
+    up: a pjit'd program's collective layout is baked per device set, so
+    a battery warmed for the 8-device mesh must read COLD for the
+    4-device sub-mesh a fleet re-pack hands the tenant (the sub-mesh
+    white-box test pins this). Single-chip runs (``mesh=None``/1) keep
+    the exact pre-fleet key, byte-for-byte — the DEEQU_TPU_FLEET=0
+    escape hatch depends on it. An EMPTY battery (grouping/host-only
     checks) stays the empty signature — there is nothing to warm, and
     decide() must keep its no-battery early-out."""
     battery = battery_signature(analyzers)
     if not battery:
         return ()
-    return battery + (("__batch__", int(batch_size)),)
+    signature = battery + (("__batch__", int(batch_size)),)
+    n_dev = _mesh_devices(mesh)
+    if n_dev > 1:
+        signature += (("__mesh__", n_dev),)
+    return signature
 
 
 def make_warm_fn(
@@ -79,16 +104,21 @@ def make_warm_fn(
     from a DETACHED 1-row sample, so the queued closure never pins the
     job's dataset. The single construction point for both one-shot jobs
     and streaming ingests — the two paths' warmth behavior cannot drift
-    apart."""
-    signature = shape_qualified_signature(analyzers, batch_size)
+    apart. ``mesh`` may be a Mesh or a fleet :class:`SubMeshLease`; the
+    warm then compiles the pjit'd program for that exact device slice,
+    and the warmth key carries its shape."""
+    signature = shape_qualified_signature(analyzers, batch_size, mesh)
     if not signature or router.is_warm(signature):
         return None
     from ..runners.engine import detached_warm_sample, warm_fused_program
 
+    warm_mesh = getattr(mesh, "mesh", mesh)  # a lease unwraps to its Mesh
     sample = detached_warm_sample(data)
 
     def warm():
-        warm_fused_program(analyzers, mesh, data=sample, batch_size=batch_size)
+        warm_fused_program(
+            analyzers, warm_mesh, data=sample, batch_size=batch_size
+        )
 
     return warm
 

@@ -63,7 +63,9 @@ class Priority(enum.IntEnum):
 @dataclass
 class JobContext:
     """What a job body receives: identity, attempt number, the worker it
-    landed on, the placement the router chose for this attempt, and a
+    landed on, the placement the router chose for this attempt, the
+    tenant's leased sub-mesh (``mesh``; None = single chip — the fleet
+    scheduler grants it per attempt when the job asked for one), and a
     RunMonitor the scheduler harvests into the export plane afterwards —
     also on failure, so a crashing run still reports its phase costs."""
 
@@ -72,6 +74,7 @@ class JobContext:
     attempt: int
     worker_id: int
     placement: Optional[str]
+    mesh: Optional[Any] = None
     monitor: RunMonitor = field(default_factory=RunMonitor)
 
 
@@ -120,11 +123,12 @@ class _Job:
         "job_id", "fn", "tenant", "priority", "deadline_s", "deadline_abs",
         "submit_time", "max_retries", "retry_backoff_s", "retry_on",
         "signature", "handle", "attempts", "seq", "warm_fn", "serial_key",
-        "span", "defer_key",
+        "span", "defer_key", "mesh_tenant",
     )
 
     def __init__(self, **kw):
         self.defer_key = None
+        self.mesh_tenant = None
         for k, v in kw.items():
             setattr(self, k, v)
         self.attempts = 0
@@ -162,9 +166,16 @@ class JobScheduler:
         metrics: Optional[ServiceMetrics] = None,
         router: Optional[PlacementRouter] = None,
         name: str = "deequ-service",
+        fleet=None,
     ):
         self.metrics = metrics or ServiceMetrics()
         self.router = router or PlacementRouter(self.metrics)
+        #: the fleet scheduler (service.fleet.FleetScheduler) packing
+        #: tenants onto disjoint sub-meshes; None = single-chip routing
+        #: (the DEEQU_TPU_FLEET=0 escape hatch, or a single-device box).
+        #: Jobs submitted with ``mesh_tenant`` lease their tenant's slice
+        #: for the duration of each attempt.
+        self.fleet = fleet
         self.max_queue_depth = int(max_queue_depth)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -295,6 +306,7 @@ class JobScheduler:
         serial_key: Optional[Any] = None,
         block_s: Optional[float] = None,
         defer_key: Optional[Any] = None,
+        mesh_tenant: Optional[str] = None,
     ) -> JobHandle:
         """Admit one job, or shed it with :class:`ServiceOverloaded`.
 
@@ -310,7 +322,13 @@ class JobScheduler:
         frees a slot instead of shedding immediately — the semantics a
         streaming producer wants (slow down, don't drop), bounded so a
         wedged service still sheds typed rather than hanging the producer
-        forever. ``None`` (default) keeps the shed-immediately contract."""
+        forever. ``None`` (default) keeps the shed-immediately contract.
+
+        ``mesh_tenant`` opts the job into FLEET scheduling: each attempt
+        leases that tenant's sub-mesh from the fleet scheduler (disjoint
+        from other tenants' slices) and hands it to the body as
+        ``ctx.mesh``; the lease releases when the attempt ends. Ignored
+        when the scheduler has no fleet (single chip)."""
         with self._cond:
             if self._closed:
                 raise ServiceClosed("verification service is shut down")
@@ -341,6 +359,7 @@ class JobScheduler:
                 retry_on=tuple(retry_on), signature=signature,
                 handle=handle, seq=seq, warm_fn=warm_fn,
                 serial_key=serial_key, defer_key=defer_key,
+                mesh_tenant=mesh_tenant,
             )
             # the trace root of the job's whole causal chain: admission,
             # every attempt/retry, placement, the engine passes it runs
@@ -538,36 +557,57 @@ class JobScheduler:
         job.span.add_event(
             "picked_up", worker=worker_id, attempt=job.attempts
         )
-        ctx = JobContext(
-            job_id=job.job_id, tenant=job.tenant, attempt=job.attempts,
-            worker_id=worker_id,
-            placement=self.router.decide(job.signature, job.warm_fn),
-        )
-        job.span.add_event(
-            "placement", decision=ctx.placement or "auto", attempt=job.attempts
-        )
+        # fleet: lease the tenant's sub-mesh for THIS attempt — disjoint
+        # from other tenants' slices, re-packed over survivors when a
+        # shard dropped out of the ladder since the last attempt. The
+        # release lives in a finally so no path out of the attempt —
+        # including a raising router.decide — can leak the refcount (a
+        # leaked ref would pin a phantom tenant into every future
+        # packing)
+        lease = None
+        if job.mesh_tenant is not None and self.fleet is not None:
+            lease = self.fleet.acquire(job.mesh_tenant)
         try:
-            from ..reliability.faults import fault_point
+            ctx = JobContext(
+                job_id=job.job_id, tenant=job.tenant, attempt=job.attempts,
+                worker_id=worker_id,
+                placement=self.router.decide(job.signature, job.warm_fn),
+                mesh=lease.mesh if lease is not None else None,
+            )
+            job.span.add_event(
+                "placement", decision=ctx.placement or "auto",
+                attempt=job.attempts,
+                **({"fleet_devices": lease.n_dev}
+                   if lease is not None else {}),
+            )
+            try:
+                from ..reliability.faults import fault_point
 
-            # chaos site: a WorkerCrash here simulates the worker dying
-            # mid-job (executor loss); the job must still terminate typed
-            fault_point("worker", tag=str(worker_id))
-            value = job.fn(ctx)
-        except BaseException as exc:  # noqa: BLE001 - routed into the taxonomy
+                # chaos site: a WorkerCrash here simulates the worker
+                # dying mid-job (executor loss); the job must still
+                # terminate typed
+                fault_point("worker", tag=str(worker_id))
+                value = job.fn(ctx)
+            except BaseException as exc:  # noqa: BLE001 - routed into
+                # the taxonomy below
+                self._harvest(job, ctx)
+                if self._maybe_retry(job, exc):
+                    return True  # worker keeps the serial key (FIFO)
+                if isinstance(exc, ServiceError) and not isinstance(
+                    exc, TransientFailure
+                ):
+                    self._finish(job, None, exc, outcome="failed")
+                else:
+                    self._finish(
+                        job, None,
+                        JobFailed(job.job_id, job.attempts, exc),
+                        outcome="failed",
+                    )
+                return False
             self._harvest(job, ctx)
-            if self._maybe_retry(job, exc):
-                return True  # worker keeps the serial key owned (FIFO)
-            if isinstance(exc, ServiceError) and not isinstance(
-                exc, TransientFailure
-            ):
-                self._finish(job, None, exc, outcome="failed")
-            else:
-                self._finish(
-                    job, None, JobFailed(job.job_id, job.attempts, exc),
-                    outcome="failed",
-                )
-            return False
-        self._harvest(job, ctx)
+        finally:
+            if lease is not None:
+                self.fleet.release(job.mesh_tenant)
         # the monitor records the placement the engine actually RESOLVED
         # (None for jobs that never touched the engine)
         self.router.note_ran(job.signature, worker_id, ctx.monitor.placement)
@@ -736,6 +776,12 @@ class JobScheduler:
             # probation window (also fires on failed attempts, so a retry
             # lands on the healthy tier immediately)
             self.router.note_device_failure(signature)
+        if monitor.shard_losses and self.fleet is not None:
+            # a shard dropped out of the ladder during this job: make sure
+            # the fleet packing reflects it (the elastic loss listener
+            # usually already did — this probe-and-repack is the backstop
+            # for pass-level GSPMD failures that never named a device)
+            self.fleet.note_shard_loss()
 
     def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:
         from ..exceptions import ScanStallError

@@ -241,10 +241,21 @@ class StreamingSession:
                     signature, warm = (), None
                 else:
                     signature = shape_qualified_signature(self._analyzers, bs)
-                    warm = make_warm_fn(
-                        self.service.router, self._analyzers,
-                        self.service.mesh, data, bs,
-                    )
+                    if coalescer._fleet_stream_eligible(
+                        pending.plan, int(data.num_rows),
+                        tenant=self.tenant,
+                    ):
+                        # the drain will shard this fold over the fleet
+                        # sub-mesh (host partials + collectives): there
+                        # is no single-chip fused program to warm, and
+                        # compiling one in the background would be a
+                        # wasted cold XLA compile per (battery, bucket)
+                        warm = None
+                    else:
+                        warm = make_warm_fn(
+                            self.service.router, self._analyzers,
+                            self.service.mesh, data, bs,
+                        )
             else:
                 # a SERIAL-path fold raises the session's coalescer
                 # barrier: no later drainable fold may be cross-drained
@@ -264,9 +275,18 @@ class StreamingSession:
                             done["barrier_cleared"] = True
                             coalescer.clear_serial_barrier(skey)
 
-                signature = shape_qualified_signature(self._analyzers, bs)
+                # under the fleet, a LARGE serial delta shards over the
+                # tenant's sub-mesh (the job leases it via mesh_tenant);
+                # warmth keys carry the slice shape so a re-packed
+                # tenant's battery reads cold at its new mesh shape
+                serial_mesh = self._fold_mesh_hint(int(data.num_rows))
+                signature = shape_qualified_signature(
+                    self._analyzers, bs, serial_mesh
+                )
                 warm = make_warm_fn(
-                    self.service.router, self._analyzers, self.service.mesh,
+                    self.service.router, self._analyzers,
+                    serial_mesh if serial_mesh is not None
+                    else self.service.mesh,
                     data, bs,
                 )
             try:
@@ -302,6 +322,16 @@ class StreamingSession:
                     defer_key=(
                         pending.key
                         if pending is not None and pending.drainable
+                        else None
+                    ),
+                    # SERIAL-path folds over fleet-sized deltas lease the
+                    # tenant's sub-mesh per attempt (coalesced folds lease
+                    # inside their drain instead); the ONE hint computed
+                    # above keeps the warmth key and the lease opt-in
+                    # agreeing even across a concurrent re-pack
+                    mesh_tenant=(
+                        self.tenant
+                        if pending is None and serial_mesh is not None
                         else None
                     ),
                 )
@@ -342,6 +372,25 @@ class StreamingSession:
                 raise
         return handle
 
+    def _fold_mesh_hint(self, rows: int):
+        """The mesh this session's SERIAL fold of ``rows`` rows would
+        shard over: the service's explicit mesh when one exists, else the
+        tenant's fleet slice for fleet-sized deltas (a lease-shaped peek
+        — the job's attempt leases the real thing), else None (single
+        chip). Drives both the warmth key and the mesh_tenant opt-in."""
+        svc = self.service
+        if svc.mesh is not None:
+            return svc.mesh
+        fleet = getattr(svc, "fleet", None)
+        if fleet is None:
+            return None
+        from .fleet import fleet_stream_min_rows
+
+        if rows < fleet_stream_min_rows():
+            return None
+        lease = fleet.peek(self.tenant)
+        return lease if lease.n_dev >= 2 else None
+
     def _fold_batch(
         self, ctx: JobContext, data: Dataset, done: dict, batch_size: int
     ):
@@ -369,7 +418,13 @@ class StreamingSession:
                 save_states_with=self.provider,
                 batch_size=batch_size,
                 monitor=ctx.monitor,
-                sharding=self.service.mesh,
+                # the attempt's fleet lease (ctx.mesh) when one was
+                # granted, else the service's explicit mesh, else single
+                # chip — exactly the order _fold_mesh_hint promised the
+                # warmth key
+                sharding=(
+                    ctx.mesh if ctx.mesh is not None else self.service.mesh
+                ),
                 placement=ctx.placement,
             )
             self._commit_fold(result, data, pending_contract, done)

@@ -1,0 +1,147 @@
+"""Perf-regression gate tests (ISSUE 12 satellite): `tools.bench_diff`
+must flag an artificially degraded run against the committed trajectory
+and pass a clean re-run — the acceptance drill, run against the REAL
+committed artifacts so the gate and the trajectory can never drift."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.fleet
+
+
+def _committed():
+    from tools.bench_diff import _latest_artifact, _metrics_of
+
+    path = _latest_artifact(REPO, "BENCH_r*.json")
+    assert path is not None, "a committed BENCH_r*.json must parse"
+    with open(path) as fh:
+        return _metrics_of(json.load(fh))
+
+
+def test_clean_rerun_passes():
+    from tools.bench_diff import diff_metrics
+
+    committed = _committed()
+    result = diff_metrics(json.loads(json.dumps(committed)), committed)
+    assert result["ok"], result["regressions"]
+    assert not result["regressions"]
+
+
+def test_degraded_run_flags_named_stages():
+    from tools.bench_diff import diff_metrics, render_report
+
+    committed = _committed()
+    bad = json.loads(json.dumps(committed))
+    bad["grouping_rows_per_sec"] = committed["grouping_rows_per_sec"] / 2
+    bad["grouping_peak_rss_gb"] = committed["grouping_peak_rss_gb"] * 2
+    bad["stages"]["scan"]["compiles"] = (
+        committed["stages"]["scan"].get("compiles", 0) + 3
+    )
+    result = diff_metrics(bad, committed)
+    assert not result["ok"]
+    flagged = {(r["stage"], r["kind"]) for r in result["regressions"]}
+    assert ("grouping", "throughput") in flagged
+    assert ("grouping", "rss") in flagged
+    assert ("scan", "compiles") in flagged
+    report = render_report(result)
+    assert "grouping" in report and "PERF REGRESSION" in report
+
+
+def test_small_wobble_stays_inside_the_band():
+    from tools.bench_diff import diff_metrics
+
+    committed = _committed()
+    wobbly = json.loads(json.dumps(committed))
+    for key in ("grouping_rows_per_sec", "ingest_mb_per_s"):
+        if key in wobbly:
+            wobbly[key] = committed[key] * 0.9  # -10%: inside the 25% band
+    assert diff_metrics(wobbly, committed)["ok"]
+
+
+def test_substrate_change_skips_mesh_points_instead_of_lying():
+    from tools.bench_diff import diff_metrics
+
+    committed = _committed()
+    fresh = json.loads(json.dumps(committed))
+    fresh["mesh_substrate"] = {"substrate": "accelerator"}
+    committed = json.loads(json.dumps(committed))
+    committed["mesh_substrate"] = {"substrate": "cpu-virtual"}
+    # an accelerator mesh is 10x the virtual-CPU points — that must be
+    # SKIPPED (incomparable), not reported as a 10x improvement
+    fresh["mesh_scaling_rows_per_sec"] = {
+        k: v * 10 for k, v in committed["mesh_scaling_rows_per_sec"].items()
+    }
+    result = diff_metrics(fresh, committed)
+    assert result["ok"]
+    skipped = [s for s in result["skipped"] if s["stage"] == "mesh_scaling"]
+    assert skipped, "substrate-mismatched mesh points must be skipped"
+
+
+def test_missing_mesh_point_is_reported_not_silently_green():
+    from tools.bench_diff import diff_metrics
+
+    committed = _committed()
+    fresh = json.loads(json.dumps(committed))
+    # the fresh run produced no 8-device point (deadline / fewer devices)
+    fresh["mesh_scaling_rows_per_sec"].pop("8")
+    result = diff_metrics(fresh, committed)
+    assert any(
+        s["metric"] == "mesh_scaling_rows_per_sec[8]"
+        and s["reason"] == "missing from fresh run"
+        for s in result["skipped"]
+    ), result["skipped"]
+
+
+def test_skipped_fresh_stage_is_reported_not_compared():
+    from tools.bench_diff import diff_metrics
+
+    committed = _committed()
+    fresh = json.loads(json.dumps(committed))
+    fresh["stages"]["grouping"] = {"status": "skipped_deadline"}
+    fresh["grouping_rows_per_sec"] = 1.0  # stale garbage must not compare
+    result = diff_metrics(fresh, committed)
+    assert all(
+        r["stage"] != "grouping" or r["kind"] == "compiles"
+        for r in result["regressions"]
+    )
+    assert any(s["stage"] == "grouping" for s in result["skipped"])
+
+
+def test_knee_trajectory_gates_streaming_headline():
+    from tools.bench_diff import _latest_artifact, diff_metrics
+
+    committed = _committed()
+    knee_path = _latest_artifact(REPO, "KNEE_r*.json")
+    assert knee_path is not None
+    with open(knee_path) as fh:
+        knee = json.load(fh)
+    fresh = json.loads(json.dumps(committed))
+    fresh["streaming_knee_sessions_per_s"] = (
+        knee["headline_sessions_per_s"] / 3
+    )
+    result = diff_metrics(fresh, committed, knee=knee)
+    assert any(
+        "KNEE" in r["metric"] for r in result["regressions"]
+    ), result
+
+
+def test_cli_exit_codes(tmp_path):
+    from tools.bench_diff import main
+
+    committed = _committed()
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(committed))
+    assert main([str(clean)]) == 0
+    bad_doc = json.loads(json.dumps(committed))
+    bad_doc["grouping_rows_per_sec"] = 1.0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_doc))
+    assert main([str(bad)]) == 1
+    missing = tmp_path / "nope.json"
+    assert main([str(missing)]) == 2

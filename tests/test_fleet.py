@@ -1,0 +1,419 @@
+"""Fleet scheduler tests (ISSUE 12): default-on mesh sharding, disjoint
+sub-mesh packing, mesh-shape-qualified warmth keys, elastic re-packing.
+
+The conftest provisions 8 virtual CPU devices, so every packing shape the
+fleet cuts (8 / 4+4 / 2-device slices) is executable here. The fleet is
+OFF by default on the CPU backend (virtual devices share host cores), so
+each test opts in explicitly with ``VerificationService(fleet=True)`` or
+``DEEQU_TPU_FLEET=1`` — the same override an operator uses for drills.
+
+Bit-exactness discipline: the parity batteries use INTEGER-VALUED columns
+whose sums are exact in float64, so metrics are bit-identical regardless
+of how many shards the fold was split across (merge re-association of
+exact sums cannot round). That is what lets "alone on the full 8-device
+mesh" compare ``==`` against "packed onto a 4-device sub-mesh".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.data import Dataset
+
+pytestmark = pytest.mark.fleet
+
+
+def _exact_checks():
+    """A battery whose merges are exact at any shard split (counts,
+    min/max, integer-valued sums)."""
+    return [
+        Check(CheckLevel.ERROR, "fleet parity")
+        .has_size(lambda n: n > 0)
+        .is_complete("x")
+        .has_min("x", lambda v: v >= 0)
+        .has_max("x", lambda v: v < 1000)
+        .has_sum("x", lambda s: s > 0),
+    ]
+
+
+def _exact_data(rows: int = 100_000, seed: int = 0) -> Dataset:
+    rng = np.random.default_rng(seed)
+    return Dataset.from_dict(
+        {"x": rng.integers(0, 1000, rows).astype(np.float64)}
+    )
+
+
+def _values(result):
+    return {
+        repr(a): m.value.get()
+        for a, m in result.metrics.items()
+        if m.value.is_success
+    }
+
+
+class TestPacking:
+    def _fleet(self, n=8):
+        from deequ_tpu.service.fleet import FleetScheduler
+
+        class _Dev:
+            def __init__(self, i):
+                self.id = i
+                self.device_kind = "fake"
+
+        return FleetScheduler(devices=[_Dev(i) for i in range(n)])
+
+    def test_slice_sizes(self):
+        from deequ_tpu.service.fleet import FleetScheduler
+
+        size = FleetScheduler._slice_size
+        assert size(8, 1) == 8
+        assert size(8, 2) == 4
+        assert size(8, 3) == 2
+        assert size(8, 4) == 2
+        assert size(8, 5) == 1
+        assert size(7, 2) == 2  # post-loss: largest pow2 <= 3
+        assert size(1, 1) == 1
+        assert size(0, 1) == 0
+
+    def test_two_tenants_disjoint_halves(self):
+        fleet = self._fleet()
+        try:
+            fleet.acquire("a")
+            fleet.acquire("b")
+            a, b = fleet.devices_of("a"), fleet.devices_of("b")
+            assert len(a) == len(b) == 4
+            assert not set(a) & set(b)
+        finally:
+            fleet.close()
+
+    def test_four_tenants_disjoint_pairs(self):
+        fleet = self._fleet()
+        try:
+            for t in "abcd":
+                fleet.acquire(t)
+            slices = [set(fleet.devices_of(t)) for t in "abcd"]
+            assert all(len(s) == 2 for s in slices)
+            for i in range(4):
+                for j in range(i + 1, 4):
+                    assert not slices[i] & slices[j]
+        finally:
+            fleet.close()
+
+    def test_membership_is_sticky_across_release(self):
+        """Releasing the last lease must NOT re-pack (mesh shapes would
+        oscillate per drain); the slice stays assigned until eviction."""
+        fleet = self._fleet()
+        try:
+            fleet.acquire("a")
+            gen = fleet.snapshot()["generation"]
+            fleet.release("a")
+            assert fleet.snapshot()["generation"] == gen
+            assert fleet.devices_of("a")  # still assigned
+            assert fleet.evict_idle() == 1
+            assert not fleet.devices_of("a")
+        finally:
+            fleet.close()
+
+    def test_repacks_counter_matches_snapshot(self):
+        """Every re-pack (membership growth AND loss) reaches the export
+        plane — the counter and snapshot()['repacks'] never diverge."""
+        fleet = self._fleet()
+        try:
+            fleet.acquire("a")
+            fleet.acquire("b")
+            fleet.mark_unhealthy([7])
+            snap = fleet.snapshot()
+            counted = fleet.metrics.counter_value(
+                "deequ_service_fleet_repacks_total"
+            )
+            assert counted == float(snap["repacks"]) == 3.0
+        finally:
+            fleet.close()
+
+    def test_idle_tenants_reclaimed_at_next_repack(self):
+        """A departed tenant must not shrink live tenants' slices
+        forever: the next natural re-pack prunes members past the idle
+        TTL. A tenant merely BETWEEN folds (zero refs, recent activity)
+        must survive the same re-pack — sequential multi-tenant
+        workloads depend on that stickiness."""
+        import time
+
+        fleet = self._fleet()
+        try:
+            for t in "abcd":
+                fleet.acquire(t)
+            for t in "abcd":
+                fleet.release(t)
+            # a, b, c departed LONG ago; d is just between folds
+            for t in "abc":
+                fleet._last_seen[t] = (
+                    time.monotonic() - fleet.IDLE_TTL_S - 1
+                )
+            assert len(fleet.devices_of("d")) == 2  # old packing holds
+            fleet.acquire("e")  # arrival re-packs; TTL-idle members drop
+            snap = fleet.snapshot()
+            assert set(snap["tenants"]) == {"d", "e"}
+            assert len(fleet.devices_of("d")) == 4
+            assert len(fleet.devices_of("e")) == 4
+        finally:
+            fleet.close()
+
+    def test_loss_repacks_over_survivors(self):
+        fleet = self._fleet()
+        try:
+            fleet.acquire("a")
+            fleet.acquire("b")
+            fleet.mark_unhealthy([5])
+            snap = fleet.snapshot()
+            assert 5 not in snap["healthy"]
+            for positions in snap["assignment"].values():
+                assert 5 not in positions
+            a, b = fleet.devices_of("a"), fleet.devices_of("b")
+            assert a and b and not set(a) & set(b)
+        finally:
+            fleet.close()
+
+    def test_peek_predicts_the_slice_acquire_grants(self):
+        """The submit-time warmth key / warm closure compile for the
+        slice the pickup-time lease will ACTUALLY grant — peeking the
+        first free slice instead would warm the wrong device tuple for
+        every non-first tenant."""
+        fleet = self._fleet()
+        try:
+            fleet.acquire("a")
+            for t in ("b", "c", "d"):
+                predicted = fleet.peek(t)
+                granted = fleet.acquire(t)
+                assert predicted.positions == granted.positions, (
+                    t, predicted.positions, granted.positions,
+                )
+        finally:
+            fleet.close()
+
+    def test_more_tenants_than_devices_wrap(self):
+        fleet = self._fleet(n=2)
+        try:
+            for i in range(5):
+                fleet.acquire(f"t{i}")
+            # every tenant still gets a (single-chip) slice
+            assert all(fleet.devices_of(f"t{i}") for i in range(5))
+        finally:
+            fleet.close()
+
+
+class TestMeshQualifiedWarmth:
+    """The cache white-box satellite: a 4-device sub-mesh must MISS on an
+    8-device-warm battery."""
+
+    def test_signature_carries_mesh_shape(self):
+        from deequ_tpu.analyzers import Completeness, Size
+        from deequ_tpu.service import shape_qualified_signature
+
+        battery = [Size(), Completeness("x")]
+        plain = shape_qualified_signature(battery, 4096)
+        at8 = shape_qualified_signature(battery, 4096, 8)
+        at4 = shape_qualified_signature(battery, 4096, 4)
+        assert plain != at8 != at4
+        assert ("__mesh__", 8) in at8
+        assert ("__mesh__", 4) in at4
+        # single chip keeps the EXACT pre-fleet key (the escape hatch's
+        # byte-for-byte promise)
+        assert shape_qualified_signature(battery, 4096, 1) == plain
+        assert shape_qualified_signature(battery, 4096, None) == plain
+
+    def test_submesh_misses_on_full_mesh_warmth(self):
+        from deequ_tpu.analyzers import Completeness, Size
+        from deequ_tpu.service import (
+            PlacementRouter,
+            shape_qualified_signature,
+        )
+
+        battery = [Size(), Completeness("x")]
+        router = PlacementRouter(background_warm=False)
+        try:
+            sig8 = shape_qualified_signature(battery, 4096, 8)
+            sig4 = shape_qualified_signature(battery, 4096, 4)
+            router.note_ran(sig8, worker_id=0, placement="device")
+            assert router.is_warm(sig8)
+            # the 4-device sub-mesh reads COLD: its pjit program has a
+            # different collective layout than the 8-device one
+            assert not router.is_warm(sig4)
+            assert router.decide(sig4) == "host"
+        finally:
+            router.close()
+
+    def test_lease_qualifies_like_its_device_count(self):
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.service import shape_qualified_signature
+        from deequ_tpu.service.fleet import FleetScheduler
+
+        fleet = FleetScheduler(devices=list(range(8)))
+        try:
+            lease = fleet.acquire("a")
+            sig = shape_qualified_signature([Size()], 1024, lease)
+            assert ("__mesh__", lease.n_dev) in sig
+        finally:
+            fleet.close()
+
+
+class TestSubMeshIsolationParity:
+    """Two tenants on disjoint sub-meshes produce bit-exact metrics vs
+    each running ALONE on the full mesh (the sub-mesh isolation parity
+    satellite)."""
+
+    def test_batch_jobs_bit_exact(self):
+        from deequ_tpu.service import VerificationService
+
+        checks = _exact_checks()
+        data_a = _exact_data(seed=1)
+        data_b = _exact_data(seed=2)
+
+        def run_alone(data):
+            with VerificationService(
+                workers=2, background_warm=False, fleet=True
+            ) as svc:
+                lease = svc.fleet.peek("solo")
+                assert lease.n_dev == 8  # alone -> the full mesh
+                return _values(
+                    svc.verify(data, checks, tenant="solo", timeout=120)
+                )
+
+        alone_a = run_alone(data_a)
+        alone_b = run_alone(data_b)
+
+        with VerificationService(
+            workers=4, background_warm=False, fleet=True
+        ) as svc:
+            ha = svc.submit_verification(data_a, checks, tenant="a")
+            hb = svc.submit_verification(data_b, checks, tenant="b")
+            ra, rb = ha.result(120), hb.result(120)
+            pos_a = svc.fleet.devices_of("a")
+            pos_b = svc.fleet.devices_of("b")
+        assert len(pos_a) == len(pos_b) == 4
+        assert not set(pos_a) & set(pos_b)
+        assert _values(ra) == alone_a
+        assert _values(rb) == alone_b
+
+    def test_single_chip_escape_hatch_bit_exact(self, monkeypatch):
+        """DEEQU_TPU_FLEET=0 restores single-chip routing; metrics equal
+        the fleet-sharded run bit-for-bit on the exact battery."""
+        from deequ_tpu.service import VerificationService
+
+        checks = _exact_checks()
+        data = _exact_data(seed=3)
+        with VerificationService(
+            workers=2, background_warm=False, fleet=True
+        ) as svc:
+            sharded = _values(
+                svc.verify(data, checks, tenant="a", timeout=120)
+            )
+        monkeypatch.setenv("DEEQU_TPU_FLEET", "0")
+        with VerificationService(workers=2, background_warm=False) as svc:
+            assert svc.fleet is None
+            single = _values(
+                svc.verify(data, checks, tenant="a", timeout=120)
+            )
+        assert sharded == single
+
+
+class TestFleetStreaming:
+    """Streaming folds shard-local + butterfly-merge at drain boundaries
+    when the fleet grants a multi-device slice."""
+
+    @pytest.fixture(autouse=True)
+    def _force_stream_mesh(self, monkeypatch):
+        # shard every eligible fold (no 64k floor); the mesh floor
+        # outranks the crossover's fast route by contract, so no
+        # DEEQU_TPU_FAST_PATH_MAX_ROWS override is needed — these tests
+        # pin exactly that
+        monkeypatch.setenv("DEEQU_TPU_FLEET_STREAM_MIN_ROWS", "0")
+
+    def _table(self, seed: int, rows: int = 8192):
+        import pyarrow as pa
+
+        r = np.random.default_rng(seed)
+        return pa.table(
+            {"x": r.integers(0, 1000, rows).astype(np.float64)}
+        )
+
+    def test_mesh_stream_folds_bit_exact_vs_single_chip(self, monkeypatch):
+        from deequ_tpu.service import VerificationService
+
+        def run(fleet: bool):
+            with VerificationService(
+                workers=2, background_warm=False, fleet=fleet
+            ) as svc:
+                session = svc.session("t-a", "stream", _exact_checks())
+                for b in range(3):
+                    session.ingest(self._table(b))
+                folds = svc.metrics.counter_value(
+                    "deequ_service_fleet_stream_folds_total"
+                )
+                return _values(session.current()), folds
+
+        fleet_metrics, fleet_folds = run(fleet=True)
+        single_metrics, single_folds = run(fleet=False)
+        assert fleet_folds == 3.0  # every fold rode the sub-mesh
+        assert not single_folds
+        assert fleet_metrics == single_metrics
+
+    def test_shard_loss_mid_stream_recovers_and_repacks(self):
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.service import VerificationService
+
+        with VerificationService(
+            workers=2, background_warm=False, fleet=True
+        ) as svc:
+            session = svc.session("t-a", "stream", _exact_checks())
+            session.ingest(self._table(0))
+            with inject(
+                FaultSpec("sharded_fold", "mesh_loss", at=1, shard=2)
+            ) as inj:
+                session.ingest(self._table(1))
+            assert inj.fired  # the loss really hit this fold
+            session.ingest(self._table(2))
+            snap = svc.fleet.snapshot()
+            cum = _values(session.current())
+        assert session.batches_ingested == 3
+        # the dead device left the packing; later folds avoid it
+        assert len(snap["healthy"]) < 8
+        with VerificationService(
+            workers=2, background_warm=False, fleet=False
+        ) as svc:
+            ref = svc.session("t-a", "stream", _exact_checks())
+            for b in range(3):
+                ref.ingest(self._table(b))
+            assert cum == _values(ref.current())
+
+
+class TestFleetDefaults:
+    def test_cpu_backend_defaults_off(self, monkeypatch):
+        from deequ_tpu.service.fleet import fleet_enabled
+
+        monkeypatch.delenv("DEEQU_TPU_FLEET", raising=False)
+        # conftest runs on the CPU backend: the virtual 8-device mesh
+        # shares host cores, so the fleet must not default on
+        assert not fleet_enabled()
+        monkeypatch.setenv("DEEQU_TPU_FLEET", "1")
+        assert fleet_enabled()
+        monkeypatch.setenv("DEEQU_TPU_FLEET", "0")
+        assert not fleet_enabled()
+
+    def test_explicit_mesh_disables_fleet(self):
+        from deequ_tpu.parallel import make_mesh
+        from deequ_tpu.service import VerificationService
+
+        with VerificationService(
+            workers=1, background_warm=False, mesh=make_mesh(2), fleet=True
+        ) as svc:
+            assert svc.fleet is None  # legacy one-global-mesh mode wins
+
+    def test_mesh_substrate_names_the_fallback(self):
+        from deequ_tpu.service import mesh_substrate
+
+        sub = mesh_substrate()
+        assert sub["substrate"] == "cpu-virtual"
+        assert sub["chip_count"] == 8
+        assert sub["backend"] == "cpu"

@@ -1,0 +1,364 @@
+"""Perf-regression gate: diff a fresh bench run against the committed
+trajectory (ROADMAP item 1's standing gate; ISSUE 12 satellite).
+
+The bench artifacts (``BENCH_r*.json``, ``KNEE_r*.json``) are the
+machine-readable trajectory PERF.md narrates; this tool diffs a NEW run's
+final JSON line against the latest committed artifacts per stage and
+exits non-zero with a named-stage report when a tracked metric regressed
+beyond the tolerance band — so the perf wins PRs 6/10 measured (16-22M
+rows/s grouping, 900+ sessions/s streaming) can never silently rot.
+
+Tracked per stage:
+
+- **throughput** (higher is better): profile/scan/ingest/grouping/spill/
+  device-scan rows-or-MB per second, mesh-scaling per-device-count
+  points, streaming-knee sessions/s;
+- **memory** (lower is better): grouping/spill peak RSS;
+- **compile counts** (must not increase): each stage's ``compiles`` field
+  — a warm stage recompiling is a regression at ANY throughput.
+
+Substrate guard: scaling numbers measured on the 8-virtual-CPU-device
+fallback model nothing about an accelerator mesh (the r06
+``vs_baseline: 0.8`` lesson). When both artifacts record a
+``mesh_substrate`` and they disagree, mesh-scaling points are reported as
+SKIPPED rather than compared.
+
+Usage::
+
+    python bench.py ... | tail -1 > /tmp/fresh.json
+    python -m tools.bench_diff /tmp/fresh.json            # gate (rc != 0 on regression)
+    python -m tools.bench_diff /tmp/fresh.json --tolerance 0.4
+    python -m tools.bench_diff /tmp/fresh.json --baseline BENCH_r06.json
+
+Exit codes: 0 = no regression, 1 = at least one named regression,
+2 = usage/artifact error. ``bench.py`` runs the same diff as its final
+``bench_diff`` stage epilogue (report-only: the bench's job is to emit
+its artifact; CI enforces with this tool's exit code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: default tolerance band for throughput/RSS comparisons: bench boxes are
+#: SHARED (r06's note), so run-to-run noise of tens of percent is normal;
+#: a regression must clear this band to flag
+DEFAULT_TOLERANCE = 0.25
+
+#: (stage, metric key, kind); kind: "throughput" higher-better,
+#: "rss" lower-better
+_SCALARS: List[Tuple[str, str, str]] = [
+    ("device_profile", "device_profile_rows_per_sec", "throughput"),
+    ("profile", "profile_rows_per_sec", "throughput"),
+    ("scan", "scan_rows_per_sec_per_chip", "throughput"),
+    ("ingest", "ingest_mb_per_s", "throughput"),
+    ("ingest", "ingest_soak_sessions_per_s", "throughput"),
+    ("device_scan", "device_scan_rows_per_sec", "throughput"),
+    ("grouping", "grouping_rows_per_sec", "throughput"),
+    ("spill", "spill_rows_per_sec", "throughput"),
+    ("streaming_knee", "streaming_knee_sessions_per_s", "throughput"),
+    ("grouping", "grouping_peak_rss_gb", "rss"),
+    ("spill", "spill_peak_rss_gb", "rss"),
+]
+
+
+def _latest_artifact(repo_dir: str, pattern: str) -> Optional[str]:
+    """The highest-round committed artifact matching e.g. BENCH_r*.json
+    that parses (and, for BENCH artifacts, carries stage metrics — early
+    rounds are known-torn)."""
+    best: Tuple[int, Optional[str]] = (-1, None)
+    rx = re.compile(re.escape(pattern).replace(r"\*", r"(\d+)"))
+    needs_metrics = pattern.startswith("BENCH")
+    for path in glob.glob(os.path.join(repo_dir, pattern)):
+        m = rx.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except Exception:  # noqa: BLE001 - early rounds are known-torn
+            continue
+        if needs_metrics and _metrics_of(doc) is None:
+            continue
+        n = int(m.group(1))
+        if n > best[0]:
+            best = (n, path)
+    return best[1]
+
+
+def _metrics_of(doc: Dict) -> Optional[Dict]:
+    """The flat metrics dict of a bench artifact: the driver wraps the
+    bench's final JSON line under ``parsed``; a raw bench line (or this
+    tool's own input) IS the metrics dict."""
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if "completed_stages" in doc or "stages" in doc:
+        return doc
+    return None
+
+
+def _stage_status(metrics: Dict, stage: str) -> Optional[str]:
+    return (metrics.get("stages") or {}).get(stage, {}).get("status")
+
+
+def _substrates_comparable(fresh: Dict, committed: Dict) -> Tuple[bool, str]:
+    fs = (fresh.get("mesh_substrate") or {}).get("substrate")
+    cs = (committed.get("mesh_substrate") or {}).get("substrate")
+    if fs is None or cs is None:
+        return True, "unrecorded"  # pre-ISSUE-12 artifacts carry no field
+    return fs == cs, f"{cs} -> {fs}"
+
+
+def diff_metrics(
+    fresh: Dict,
+    committed: Dict,
+    tolerance: float = DEFAULT_TOLERANCE,
+    knee: Optional[Dict] = None,
+) -> Dict:
+    """Compare one fresh bench metrics dict against the committed one.
+    Returns {"regressions": [...], "improvements": [...], "skipped":
+    [...], "ok": bool}; each entry names its stage and metric."""
+    regressions: List[Dict] = []
+    improvements: List[Dict] = []
+    skipped: List[Dict] = []
+
+    def compare(stage: str, metric: str, new, old, kind: str) -> None:
+        if old in (None, 0) or new is None:
+            return
+        if kind == "throughput":
+            ratio = new / old
+            bad = ratio < 1.0 - tolerance
+        else:  # rss: lower is better
+            ratio = new / old
+            bad = ratio > 1.0 + tolerance
+        entry = {
+            "stage": stage, "metric": metric,
+            "committed": round(float(old), 2), "fresh": round(float(new), 2),
+            "ratio": round(ratio, 3), "kind": kind,
+        }
+        if bad:
+            regressions.append(entry)
+        elif (kind == "throughput" and ratio > 1.0 + tolerance) or (
+            kind == "rss" and ratio < 1.0 - tolerance
+        ):
+            improvements.append(entry)
+
+    for stage, metric, kind in _SCALARS:
+        if _stage_status(fresh, stage) not in (None, "ok"):
+            # the fresh run skipped/failed the stage: the gate cannot
+            # clear it, but a deadline skip is not a measured regression
+            skipped.append({
+                "stage": stage, "metric": metric,
+                "reason": f"fresh stage {_stage_status(fresh, stage)}",
+            })
+            continue
+        compare(stage, metric, fresh.get(metric), committed.get(metric), kind)
+
+    # mesh-scaling per-device-count points, substrate-guarded
+    f_points = fresh.get("mesh_scaling_rows_per_sec") or {}
+    c_points = committed.get("mesh_scaling_rows_per_sec") or {}
+    comparable, substrate_note = _substrates_comparable(fresh, committed)
+    for n_dev, old in sorted(c_points.items(), key=lambda kv: int(kv[0])):
+        new = f_points.get(n_dev)
+        if not comparable:
+            skipped.append({
+                "stage": "mesh_scaling",
+                "metric": f"mesh_scaling_rows_per_sec[{n_dev}]",
+                "reason": f"substrate changed ({substrate_note})",
+            })
+            continue
+        if new is None:
+            # a committed point the fresh run never produced (stage
+            # deadline, fewer devices) must be VISIBLE, not a silent
+            # green — compare() cannot see an absent value
+            skipped.append({
+                "stage": "mesh_scaling",
+                "metric": f"mesh_scaling_rows_per_sec[{n_dev}]",
+                "reason": "missing from fresh run",
+            })
+            continue
+        compare(
+            "mesh_scaling", f"mesh_scaling_rows_per_sec[{n_dev}]",
+            new, old, "throughput",
+        )
+
+    # compile counts: a warm stage that recompiles regressed regardless
+    # of wall clock (the compile-budget contract, per-stage)
+    f_stages = fresh.get("stages") or {}
+    c_stages = committed.get("stages") or {}
+    for stage, c_entry in c_stages.items():
+        old = c_entry.get("compiles")
+        new = (f_stages.get(stage) or {}).get("compiles")
+        if old is None or new is None:
+            continue
+        if new > old:
+            regressions.append({
+                "stage": stage, "metric": "compiles",
+                "committed": old, "fresh": new,
+                "ratio": None, "kind": "compiles",
+            })
+
+    # streaming-knee trajectory (KNEE_r*.json): the committed headline
+    # sessions/s, against either a fresh knee artifact or the bench's
+    # streaming_knee stage
+    if knee:
+        old = knee.get("headline_sessions_per_s")
+        new = fresh.get("streaming_knee_sessions_per_s") or (
+            fresh.get("headline_sessions_per_s")
+        )
+        if old and new is not None:
+            compare(
+                "streaming_knee", "headline_sessions_per_s(KNEE_r*)",
+                new, old, "throughput",
+            )
+
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "skipped": skipped,
+        "tolerance": tolerance,
+        "ok": not regressions,
+    }
+
+
+def render_report(result: Dict) -> str:
+    lines = []
+    tol = result["tolerance"]
+    if result["regressions"]:
+        lines.append(
+            f"PERF REGRESSION: {len(result['regressions'])} metric(s) "
+            f"beyond the {tol:.0%} band vs the committed trajectory:"
+        )
+        for r in result["regressions"]:
+            if r["kind"] == "compiles":
+                lines.append(
+                    f"  [{r['stage']}] compiles {r['committed']} -> "
+                    f"{r['fresh']} (warm stage recompiled)"
+                )
+            else:
+                lines.append(
+                    f"  [{r['stage']}] {r['metric']}: "
+                    f"{r['committed']:,} -> {r['fresh']:,} "
+                    f"({r['ratio']:.2f}x, {r['kind']})"
+                )
+    else:
+        lines.append(
+            f"no regression beyond the {tol:.0%} band vs the committed "
+            "trajectory"
+        )
+    for s in result["skipped"]:
+        lines.append(
+            f"  skipped [{s['stage']}] {s['metric']}: {s['reason']}"
+        )
+    for i in result["improvements"]:
+        lines.append(
+            f"  improved [{i['stage']}] {i['metric']}: "
+            f"{i['committed']:,} -> {i['fresh']:,} ({i['ratio']:.2f}x)"
+        )
+    return "\n".join(lines)
+
+
+def run_diff_on_metrics(
+    fresh: Dict,
+    baseline_path: Optional[str] = None,
+    knee_path: Optional[str] = None,
+    tolerance: float = DEFAULT_TOLERANCE,
+    repo_dir: Optional[str] = None,
+) -> Dict:
+    """Gate an IN-MEMORY fresh metrics dict against the committed
+    trajectory: baseline/knee discovery, artifact load, diff, and
+    baseline stamping. The single orchestration both the CLI gate
+    (:func:`run_diff`) and bench.py's epilogue stage call — their
+    baseline-selection rules can never drift apart."""
+    repo_dir = repo_dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    baseline_path = baseline_path or _latest_artifact(
+        repo_dir, "BENCH_r*.json"
+    )
+    if baseline_path is None:
+        raise FileNotFoundError(
+            "no committed BENCH_r*.json artifact parses; nothing to gate "
+            "against"
+        )
+    with open(baseline_path) as fh:
+        committed = _metrics_of(json.load(fh))
+    knee = None
+    knee_path = knee_path or _latest_artifact(repo_dir, "KNEE_r*.json")
+    if knee_path:
+        try:
+            with open(knee_path) as fh:
+                knee = json.load(fh)
+        except Exception:  # noqa: BLE001 - knee trajectory is optional
+            knee = None
+    result = diff_metrics(fresh, committed, tolerance=tolerance, knee=knee)
+    result["baseline"] = os.path.basename(baseline_path)
+    if knee_path and knee:
+        result["knee_baseline"] = os.path.basename(knee_path)
+    return result
+
+
+def run_diff(
+    fresh_path: str,
+    baseline_path: Optional[str] = None,
+    knee_path: Optional[str] = None,
+    tolerance: float = DEFAULT_TOLERANCE,
+    repo_dir: Optional[str] = None,
+) -> Dict:
+    with open(fresh_path) as fh:
+        text = fh.read().strip()
+    # accept either a JSON document or a full bench stdout capture (take
+    # the last parseable JSON line — the bench's partial-result protocol)
+    fresh = None
+    for line in reversed(text.splitlines()):
+        try:
+            fresh = _metrics_of(json.loads(line))
+            if fresh is not None:
+                break
+        except Exception:  # noqa: BLE001 - not a JSON line
+            continue
+    if fresh is None:
+        raise ValueError(f"no bench metrics JSON found in {fresh_path}")
+    return run_diff_on_metrics(
+        fresh, baseline_path=baseline_path, knee_path=knee_path,
+        tolerance=tolerance, repo_dir=repo_dir,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("fresh", help="fresh bench JSON (final line, or a "
+                                      "full bench stdout capture)")
+    parser.add_argument("--baseline", help="committed BENCH_r*.json to gate "
+                                           "against (default: latest that "
+                                           "parses)")
+    parser.add_argument("--knee", help="committed KNEE_r*.json trajectory "
+                                       "(default: latest)")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="relative band a metric may move before it "
+                             "flags (default 0.25)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full result JSON on stdout")
+    args = parser.parse_args(argv)
+    try:
+        result = run_diff(
+            args.fresh, baseline_path=args.baseline, knee_path=args.knee,
+            tolerance=args.tolerance,
+        )
+    except (OSError, ValueError) as exc:
+        print(f"bench_diff: {exc}", file=sys.stderr)
+        return 2
+    print(render_report(result), file=sys.stderr, flush=True)
+    if args.json:
+        print(json.dumps(result), flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

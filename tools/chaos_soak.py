@@ -174,6 +174,7 @@ def run_soak(
             summary["mesh_drill"] = _mesh_drill(data)
             summary["ingest_drill"] = _ingest_drill(service)
             summary["coalesce_drill"] = _coalesce_drill(service)
+            summary["fleet_drill"] = _fleet_drill()
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -183,17 +184,31 @@ def run_soak(
         clear()
     summary.update(_write_trace_artifact(state_root))
     summary["seconds"] = round(time.perf_counter() - t0, 2)
-    summary["ok"] = (
-        summary["unterminated"] == 0
-        and summary["untyped_failures"] == 0
-        and summary["incomplete_metric_maps"] == 0
-        and summary["stream_fold_parity"]
-        and summary["succeeded"] + summary["typed_failures"] == jobs
-        and summary["repo_drill"]["ok"]
-        and summary["mesh_drill"]["ok"]
-        and summary["ingest_drill"]["ok"]
-        and summary["coalesce_drill"]["ok"]
+    invariants = {
+        "unterminated": summary["unterminated"] == 0,
+        "untyped_failures": summary["untyped_failures"] == 0,
+        "incomplete_metric_maps": summary["incomplete_metric_maps"] == 0,
+        "stream_fold_parity": bool(summary["stream_fold_parity"]),
+        "jobs_accounted":
+            summary["succeeded"] + summary["typed_failures"] == jobs,
+        "repo_drill": summary["repo_drill"]["ok"],
+        "mesh_drill": summary["mesh_drill"]["ok"],
+        "ingest_drill": summary["ingest_drill"]["ok"],
+        "coalesce_drill": summary["coalesce_drill"]["ok"],
+        "fleet_drill": summary["fleet_drill"]["ok"],
+    }
+    # name what broke: a soak verdict that just says False costs a whole
+    # re-run under a debugger to attribute
+    summary["failed_invariants"] = sorted(
+        name for name, held in invariants.items() if not held
     )
+    summary["ok"] = not summary["failed_invariants"]
+    if not summary["ok"]:
+        print(
+            "chaos soak invariants BROKEN: "
+            + ", ".join(summary["failed_invariants"]),
+            file=sys.stderr, flush=True,
+        )
     return summary
 
 
@@ -240,6 +255,138 @@ def _mesh_drill(data) -> Dict:
         "parity": parity,
         "ok": parity and mon.shard_losses >= 1 and mon.mesh_reshards >= 1,
     }
+
+
+def _fleet_drill() -> Dict:
+    """Fleet drill (ISSUE 12): a multi-tenant streaming soak on DISJOINT
+    sub-meshes takes a SIGKILL-equivalent shard loss mid-soak (injected
+    ``mesh_loss`` on the sharded fold — from the fold's side a killed
+    chip and a killed process look identical: the collective dies). The
+    verdict asserts the fleet RE-PACKED tenants onto the surviving
+    sub-meshes with ZERO sheds and per-tenant cumulative metrics
+    BIT-EXACT against clean single-chip runs (the battery's merges are
+    exact integer sums, so shard-split re-association cannot round).
+    Needs >= 2 devices (the conftest's virtual 8; skipped-as-ok on a
+    single-chip box, like the mesh drill's host-ladder leg)."""
+    import os
+
+    import jax
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.reliability import FaultSpec, inject
+    from deequ_tpu.service import VerificationService
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "single device", "ok": True}
+
+    def fleet_checks():
+        return [
+            Check(CheckLevel.ERROR, "fleet soak")
+            .has_size(lambda n: n > 0)
+            .is_complete("x")
+            .has_min("x", lambda v: v >= 0)
+            .has_sum("x", lambda s: s > 0),
+        ]
+
+    def table(tenant_seed: int, batch: int, rows: int = 4096):
+        r = np.random.default_rng(1000 * tenant_seed + batch)
+        return pa.table({"x": r.integers(0, 997, rows).astype(np.float64)})
+
+    tenants = ("fleet-a", "fleet-b")
+    batches = 4
+    out: Dict = {}
+    os.environ["DEEQU_TPU_FLEET_STREAM_MIN_ROWS"] = "0"
+    os.environ["DEEQU_TPU_FAST_PATH_MAX_ROWS"] = "0"
+    try:
+        # the loss fires on the THIRD sharded fold — mid-soak, after both
+        # tenants folded at least once on their original slices
+        with inject(
+            FaultSpec("sharded_fold", "mesh_loss", at=3, shard=1)
+        ) as inj:
+            with VerificationService(
+                workers=4, background_warm=False, fleet=True,
+            ) as svc:
+                sessions = {
+                    t: svc.session(t, "soak", fleet_checks())
+                    for t in tenants
+                }
+                slices_before = {}
+                for b in range(batches):
+                    for i, t in enumerate(tenants):
+                        sessions[t].ingest(table(i, b))
+                    if b == 0:
+                        # both tenants leased once: the pre-loss packing
+                        slices_before = {
+                            t: svc.fleet.devices_of(t) for t in tenants
+                        }
+                snapshot = svc.fleet.snapshot()
+                cumulative = {
+                    t: {
+                        repr(a): m.value.get()
+                        for a, m in sessions[t].current().metrics.items()
+                        if m.value.is_success
+                    }
+                    for t in tenants
+                }
+                committed = {
+                    t: sessions[t].batches_ingested for t in tenants
+                }
+                shed = svc.metrics.counter_value(
+                    "deequ_service_jobs_shed_total"
+                )
+                mesh_folds = svc.metrics.counter_value(
+                    "deequ_service_fleet_stream_folds_total"
+                )
+        # clean single-chip reference per tenant (fleet off entirely);
+        # inject() with an EMPTY plan keeps the soak's ambient faults
+        # out of the reference run — its job is to define ground truth
+        with inject():
+            with VerificationService(
+                workers=2, background_warm=False, fleet=False,
+            ) as ref_svc:
+                parity = {}
+                for i, t in enumerate(tenants):
+                    ref = ref_svc.session(t, "soak", fleet_checks())
+                    for b in range(batches):
+                        ref.ingest(table(i, b))
+                    parity[t] = cumulative[t] == {
+                        repr(a): m.value.get()
+                        for a, m in ref.current().metrics.items()
+                        if m.value.is_success
+                    }
+    finally:
+        os.environ.pop("DEEQU_TPU_FLEET_STREAM_MIN_ROWS", None)
+        os.environ.pop("DEEQU_TPU_FAST_PATH_MAX_ROWS", None)
+    disjoint_before = bool(slices_before.get(tenants[0])) and not (
+        set(slices_before[tenants[0]]) & set(slices_before[tenants[1]])
+    )
+    repacked_assignment = snapshot["assignment"]
+    disjoint_after = not (
+        set(repacked_assignment.get(tenants[0], ()))
+        & set(repacked_assignment.get(tenants[1], ()))
+    )
+    out.update({
+        "fault_fired": bool(inj.fired),
+        "slices_before": {t: list(p) for t, p in slices_before.items()},
+        "assignment_after": repacked_assignment,
+        "healthy_after": snapshot["healthy"],
+        "repacks": snapshot["repacks"],
+        "shed": shed or 0,
+        "mesh_stream_folds": mesh_folds or 0,
+        "committed": committed,
+        "parity": parity,
+    })
+    out["ok"] = (
+        bool(inj.fired)
+        and disjoint_before and disjoint_after
+        and len(snapshot["healthy"]) < len(jax.devices())  # loss stuck
+        and (out["shed"] or 0) == 0
+        and all(committed[t] == batches for t in tenants)
+        and all(parity.values())
+    )
+    return out
 
 
 def _coalesce_drill(service) -> Dict:

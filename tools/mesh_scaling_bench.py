@@ -209,10 +209,17 @@ def scan_scaling(
             "x2": rng.integers(0, 10_000, rows).astype(np.float64),
         }
     )
+    from deequ_tpu.service.fleet import mesh_substrate
+
     analyzers = scan_battery()
     n_avail = len(jax.devices())
     batch = max(1 << 12, rows // 64)
-    out: dict = {"rows": rows, "points": {}, "devices_available": n_avail}
+    # the substrate rides every artifact: a CPU-virtual-device point must
+    # never be misread as an accelerator point (r06's vs_baseline lesson)
+    out: dict = {
+        "rows": rows, "points": {}, "devices_available": n_avail,
+        "mesh_substrate": mesh_substrate(),
+    }
     clean_8 = None
     for n_dev in mesh_sizes:
         if n_dev > n_avail:

@@ -105,6 +105,12 @@ def run_knee_point(
         "rows": rows,
         "coalesce": coalesce,
         "sessions_per_s": soak["sessions_per_s"],
+        # the min/max SPREAD across repeats, not just the median: the
+        # coalesced plane has a known bimodal scheduling mode (~650-840
+        # vs ~1100-1300 sessions/s, PR 10) and committed artifacts must
+        # show it rather than leaving it folklore
+        "sessions_per_s_min": runs[0]["sessions_per_s"],
+        "sessions_per_s_max": runs[-1]["sessions_per_s"],
         "folds_per_s": soak["folds_per_s"],
         "shed": sum(r["shed"] for r in runs),
         "failed_folds": sum(r["failed_folds"] for r in runs),
@@ -144,6 +150,10 @@ def _subprocess_point(
         runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
     runs.sort(key=lambda r: r["sessions_per_s"])
     point = dict(runs[len(runs) // 2])  # fully-isolated median
+    # spread across the isolated repeats (see run_knee_point: the bimodal
+    # scheduling mode must be visible in committed artifacts)
+    point["sessions_per_s_min"] = runs[0]["sessions_per_s"]
+    point["sessions_per_s_max"] = runs[-1]["sessions_per_s"]
     point["shed"] = sum(r["shed"] for r in runs)
     point["ok"] = all(r["ok"] for r in runs)
     return point
@@ -177,6 +187,10 @@ def run_grid(
                 "sessions": sessions, "rows": rows,
                 "serial_sessions_per_s": serial["sessions_per_s"],
                 "coalesced_sessions_per_s": coalesced["sessions_per_s"],
+                "coalesced_sessions_per_s_min":
+                    coalesced["sessions_per_s_min"],
+                "coalesced_sessions_per_s_max":
+                    coalesced["sessions_per_s_max"],
                 "speedup": round(speedup, 2),
                 "shed": serial["shed"] + coalesced["shed"],
                 "ok": serial["ok"] and coalesced["ok"],
