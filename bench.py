@@ -1244,7 +1244,186 @@ def run_incremental_stage(rows_per_partition: int, n_partitions: int = 2) -> dic
         f"{state_bytes/merge_s/1e9:.2f}GB/s merge); anomaly check on "
         f"Size/Mean: steady day passes, quarter-size day flagged"
     )
-    return {"merge_seconds": merge_s, "state_bytes": state_bytes}
+    result = {"merge_seconds": merge_s, "state_bytes": state_bytes}
+    result.update(run_partition_growth_point(table))
+    return result
+
+
+def run_partition_growth_point(table) -> dict:
+    """ISSUE 13 acceptance point: a partitioned table grows by ~1% and the
+    incremental verify must touch <= 2% of the rows and cost <= 10% of the
+    measured full-scan wall time, with suite metrics BIT-EXACT against the
+    full re-scan (partition-aligned batches, so merges associate
+    identically). The stored baseline is populated through the
+    PartitionStateStore's own scan path; the +1% point is measured twice —
+    cold (first merge of the grown shape compiles) and steady-state (the
+    daily-growth repeat, after invalidating the growth partition) — and
+    the steady-state number is the gated one."""
+    import tempfile
+
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.repository.partition_store import PartitionStateStore
+    from deequ_tpu.runners.engine import RunMonitor
+    from deequ_tpu.verification import VerificationSuite
+
+    # cap the point's scale: its METRICS are ratios (cost fraction, reuse
+    # ratio), and populate pays one engine pass per baseline partition —
+    # at the full 50M-row stage shape that alone would eat the per-stage
+    # SIGALRM budget the existing halves of this stage already share
+    total_rows = min(int(table.num_rows), 10_000_000)
+    table = table.slice(0, total_rows)
+    # ~1% growth granularity needs ~100 baseline partitions; floor the
+    # partition size so smoke-scale runs still exercise the full protocol
+    # (their ratios are recorded but only meaningful at real scale)
+    n_base = min(100, max(4, total_rows // 50_000))
+    part_rows = total_rows // n_base
+    checks = [
+        Check(CheckLevel.ERROR, "incremental growth")
+        .has_size(lambda n: n > 0)
+        .is_complete("x0")
+        .has_mean("x0", lambda m: -50 < m < 50)
+        .has_sum("x1", lambda s: s != 0)
+        .has_approx_count_distinct("cat", lambda c: c > 0)
+    ]
+    analyzers = scan_battery()
+
+    def part_name(i: int) -> str:
+        return f"2026-{1 + i // 28:02d}-{1 + i % 28:02d}"
+
+    def partition(i: int) -> Dataset:
+        return Dataset.from_arrow(table.slice(i * part_rows, part_rows))
+
+    base = {part_name(i): (lambda i=i: partition(i)) for i in range(n_base)}
+    versions = {part_name(i): f"v-{i}" for i in range(n_base)}
+    store_dir = tempfile.mkdtemp(prefix="deequ-bench-partition-store-")
+    store = PartitionStateStore(store_dir)
+    log(
+        f"[incremental] partition growth point: {n_base} x {part_rows:,}"
+        f"-row partitions + 1 growth partition"
+    )
+    t0 = time.perf_counter()
+    VerificationSuite.verify_partitioned(
+        store, "bench", base, checks, analyzers,
+        checksums=versions, batch_size=part_rows,
+    )
+    populate_s = time.perf_counter() - t0
+
+    # two growth days of FRESH ~1% partitions: day 1 is the COLD point
+    # (the rollup+suffix merge shape compiles once), day 2 is the
+    # steady-state daily cost — scan one partition, fold it onto the
+    # rollup cache, rewrite the rollup — which is what the 10%-of-full
+    # acceptance bar gates
+    import pyarrow as pa
+
+    def growth_part(day: int):
+        rng = np.random.default_rng(7 + day)
+        return pa.table({
+            **{f"x{i}": pa.array(rng.normal(100 * i, 10, part_rows),
+                                 mask=rng.random(part_rows) < 0.05)
+               for i in range(4)},
+            "cat": pa.array(rng.integers(0, 100_000, part_rows)),
+        })
+
+    g1, g2 = growth_part(1), growth_part(2)
+    grown = dict(base)
+    gname1, gname2 = part_name(n_base), part_name(n_base + 1)
+    grown[gname1] = lambda: Dataset.from_arrow(g1)
+    gversions = dict(versions)
+    gversions[gname1] = "v-growth-1"
+
+    # full-scan baseline over the final grown table, partition-aligned
+    full_data = Dataset.from_arrow(pa.concat_tables([table, g1, g2]))
+    t0 = time.perf_counter()
+    full = VerificationSuite.do_verification_run(
+        full_data, checks, analyzers, batch_size=part_rows,
+    )
+    full_s = time.perf_counter() - t0
+
+    mon = RunMonitor()
+    t0 = time.perf_counter()
+    inc = VerificationSuite.verify_partitioned(
+        store, "bench", grown, checks, analyzers,
+        checksums=gversions, batch_size=part_rows, monitor=mon,
+    )
+    delta_cold_s = time.perf_counter() - t0
+    assert inc.incremental.plan.scan == [gname1], inc.incremental.plan.scan
+
+    # steady state: day-2 growth (merge programs warm, rollup advances)
+    grown[gname2] = lambda: Dataset.from_arrow(g2)
+    gversions[gname2] = "v-growth-2"
+    mon2 = RunMonitor()
+    t0 = time.perf_counter()
+    inc2 = VerificationSuite.verify_partitioned(
+        store, "bench", grown, checks, analyzers,
+        checksums=gversions, batch_size=part_rows, monitor=mon2,
+    )
+    delta_s = time.perf_counter() - t0
+    assert inc2.incremental.plan.scan == [gname2], inc2.incremental.plan.scan
+    assert mon2.partitions_rolled_up == n_base + 1, mon2.partitions_rolled_up
+    report = inc2.incremental
+
+    # non-sketch metrics are BIT-EXACT (partition-aligned batches make the
+    # merges associate identically); KLL sketches compact differently when
+    # folded per-partition vs continuously, so they hold their documented
+    # rank-error envelope instead: identical bucket boundaries (min/max
+    # merge exactly) and CDFs within 2% rank error
+    parity = all(
+        inc2.metrics[a].value.get() == m.value.get()
+        for a, m in full.metrics.items()
+        if a.name not in ("KLLSketch",)
+    )
+
+    def kll_close(got, want) -> bool:
+        gb, wb = got.buckets, want.buckets
+        if len(gb) != len(wb):
+            return False
+        if gb and (gb[0].low_value != wb[0].low_value
+                   or gb[-1].high_value != wb[-1].high_value):
+            return False
+        n_g = sum(b.count for b in gb)
+        n_w = sum(b.count for b in wb)
+        if n_g != n_w or n_g == 0:
+            return False
+        cg = cw = 0
+        for g, w in zip(gb, wb):
+            cg += g.count
+            cw += w.count
+            if abs(cg - cw) / n_g > 0.02:
+                return False
+        return True
+
+    kll_parity = all(
+        kll_close(inc2.metrics[a].value.get(), m.value.get())
+        for a, m in full.metrics.items()
+        if a.name == "KLLSketch"
+    )
+    out = {
+        "partitions": n_base + 2,
+        "partition_rows": part_rows,
+        "populate_s": round(populate_s, 3),
+        "full_scan_s": round(full_s, 3),
+        "delta_cold_s": round(delta_cold_s, 3),
+        "delta_s": round(delta_s, 3),
+        "cost_fraction": round(delta_s / full_s, 4) if full_s else None,
+        "speedup_vs_full": round(full_s / delta_s, 2) if delta_s else None,
+        "reuse_ratio": round(report.reuse_ratio, 4),
+        "rows_touched_fraction": round(report.rows_touched_fraction, 4),
+        "rows_scanned": report.rows_scanned,
+        "rows_total": report.rows_total,
+        "parity_bit_exact": bool(parity and kll_parity),
+    }
+    log(
+        f"[incremental] +1% growth: full scan {full_s:.2f}s vs incremental "
+        f"{delta_s:.3f}s ({out['cost_fraction']:.1%} of full, cold "
+        f"{delta_cold_s:.3f}s) — reuse ratio {out['reuse_ratio']:.2%}, "
+        f"rows touched {out['rows_touched_fraction']:.2%}, parity "
+        f"bit-exact={out['parity_bit_exact']}"
+    )
+    import shutil
+
+    shutil.rmtree(store_dir, ignore_errors=True)
+    return {"partition_growth": out}
 
 
 # ---------------------------------------------------------------------------
@@ -1587,7 +1766,23 @@ def main() -> None:
     if incremental is not None:
         out["state_merge_seconds"] = round(incremental["merge_seconds"], 3)
         out["state_merge_bytes"] = incremental["state_bytes"]
-        checkpoint("incremental")
+        growth = incremental.get("partition_growth") or {}
+        if growth:
+            # the ISSUE-13 acceptance point: +1% growth verified at a
+            # fraction of full-scan cost, gated by tools/bench_diff
+            out["incremental_full_scan_s"] = growth["full_scan_s"]
+            out["incremental_delta_s"] = growth["delta_s"]
+            out["incremental_cost_fraction"] = growth["cost_fraction"]
+            out["incremental_speedup_vs_full"] = growth["speedup_vs_full"]
+            out["incremental_reuse_ratio"] = growth["reuse_ratio"]
+            out["incremental_rows_touched_fraction"] = growth[
+                "rows_touched_fraction"
+            ]
+            out["incremental_parity_bit_exact"] = growth["parity_bit_exact"]
+        checkpoint(
+            "incremental",
+            extra={"partition_growth": growth} if growth else None,
+        )
 
     grouping = staged("grouping", run_grouping_stage, max(scan_rows // 2, 100_000))
     if grouping is not None:

@@ -163,6 +163,27 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Partition-aware incremental verification (implemented in
+# deequ_tpu.repository.partition_store + deequ_tpu.runners.incremental;
+# the env knobs are documented here with the other operator-facing
+# switches and re-exported below). Both follow the warn-and-fallback
+# convention where numeric.
+#
+# - DEEQU_TPU_PARTITION_STORE: root path (local or any deequ_tpu.io URI —
+#   s3://, gs://, memory://) of the service-default PartitionStateStore.
+#   When set, VerificationService plans incremental runs against it and
+#   streaming sessions flush their cumulative states into it as a
+#   partition on close. Unset = no default store (pass one explicitly).
+# - DEEQU_TPU_PARTITION_WINDOW_MONTHS: default listing window, in month
+#   buckets, for partition listings with no explicit window (0 =
+#   unlimited). The store's directory layout is time-partitioned
+#   (YYYY-MM buckets for date-named partitions), so a year of daily
+#   partitions lists in O(window) directory walks; this knob bounds the
+#   default walk for dropped-partition detection on very old stores.
+#   Unparseable values warn once and keep the default (0).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------
@@ -224,6 +245,10 @@ from .service.coalesce import (  # noqa: E402,F401
 from .service.fleet import (  # noqa: E402,F401
     FLEET_ENV,
     FLEET_STREAM_MIN_ROWS_ENV,
+)
+from .repository.partition_store import (  # noqa: E402,F401
+    PARTITION_STORE_ENV,
+    PARTITION_WINDOW_ENV,
 )
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401

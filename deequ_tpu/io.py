@@ -147,6 +147,73 @@ def join(base: str, *parts: str) -> str:
     return out
 
 
+def remove_file(path: str) -> None:
+    """Delete one file, any supported scheme. Raises on failure (missing
+    file included) — callers decide whether absence is fine."""
+    if is_local(path):
+        os.remove(_strip_file_scheme(path))
+        return
+    adapter = _resolve_remote(path)
+    fs = adapter.fs
+    if hasattr(fs, "rm_file"):  # fsspec
+        fs.rm_file(adapter.path)
+    elif hasattr(fs, "rm"):  # older fsspec
+        fs.rm(adapter.path)
+    else:  # pyarrow.fs
+        fs.delete_file(adapter.path)
+
+
+def remove_dir(path: str) -> None:
+    """Delete a directory tree, any supported scheme."""
+    if is_local(path):
+        import shutil
+
+        shutil.rmtree(_strip_file_scheme(path), ignore_errors=True)
+        return
+    adapter = _resolve_remote(path)
+    fs = adapter.fs
+    if hasattr(fs, "rm"):  # fsspec
+        fs.rm(adapter.path, recursive=True)
+    else:  # pyarrow.fs
+        fs.delete_dir(adapter.path)
+
+
+def list_dirs(path: str) -> list:
+    """Immediate child directory NAMES of ``path``, sorted; [] when the
+    path does not exist. Other failures (auth, network) RAISE — a store
+    misconfiguration must not read as an empty listing."""
+    if is_local(path):
+        local = _strip_file_scheme(path)
+        if not os.path.isdir(local):
+            return []
+        return sorted(
+            e for e in os.listdir(local)
+            if os.path.isdir(os.path.join(local, e))
+        )
+    adapter = _resolve_remote(path)
+    fs = adapter.fs
+    if hasattr(fs, "ls"):  # fsspec
+        try:
+            entries = fs.ls(adapter.path, detail=True)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            os.path.basename(str(e["name"]).rstrip("/"))
+            for e in entries
+            if e.get("type") == "directory"
+        )
+    import pyarrow.fs as pafs
+
+    infos = fs.get_file_info(
+        pafs.FileSelector(adapter.path, allow_not_found=True)
+    )
+    return sorted(
+        os.path.basename(i.path.rstrip("/"))
+        for i in infos
+        if i.type == pafs.FileType.Directory
+    )
+
+
 def write_text_atomic(path: str, payload: str) -> None:
     """Local: write-to-temp + rename so a crash mid-write never corrupts the
     target (the reference relies on HDFS create-overwrite the same way).

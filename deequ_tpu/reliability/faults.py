@@ -35,6 +35,7 @@ site                 fires
 ``checkpoint``       before an ingest checkpoint is persisted
 ``state_load``       in FileSystemStateProvider.load, tag = repr(analyzer)
 ``repository_load``  in the FS metrics repository's read-all, tag = path
+``partition_store_load``  in PartitionStateStore.get, tag = dataset/partition
 ``stream_fold``      before a streaming session's fold mutates state
 ``shard_probe``      per mesh shard in the heartbeat health probe, tag = shard
 ``frame_decode``     per ingest-plane frame before it folds, tag = frame idx

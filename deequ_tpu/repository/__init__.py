@@ -145,6 +145,12 @@ class MetricsRepositoryMultipleResultsLoader(abc.ABC):
 
 from .memory import InMemoryMetricsRepository  # noqa: E402
 from .fs import FileSystemMetricsRepository  # noqa: E402
+from .partition_store import (  # noqa: E402
+    PartitionManifest,
+    PartitionStateStore,
+    default_partition_store,
+    partition_bucket,
+)
 
 __all__ = [
     "AnalysisResult",
@@ -152,5 +158,9 @@ __all__ = [
     "InMemoryMetricsRepository",
     "MetricsRepository",
     "MetricsRepositoryMultipleResultsLoader",
+    "PartitionManifest",
+    "PartitionStateStore",
     "ResultKey",
+    "default_partition_store",
+    "partition_bucket",
 ]

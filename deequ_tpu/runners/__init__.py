@@ -2,6 +2,15 @@ from .analysis_runner import AnalysisRunner
 from .builder import Analysis, AnalysisRunBuilder
 from .context import AnalyzerContext
 from .engine import RunMonitor, ScanEngine
+from .incremental import (
+    DeltaPlan,
+    IncrementalRunReport,
+    PartitionInput,
+    contract_fingerprint,
+    profile_partitioned,
+    run_incremental,
+    suggest_partitioned,
+)
 from .exceptions import (
     EmptyStateException,
     MetricCalculationException,
@@ -17,7 +26,14 @@ __all__ = [
     "AnalysisRunBuilder",
     "AnalysisRunner",
     "AnalyzerContext",
+    "DeltaPlan",
     "EmptyStateException",
+    "IncrementalRunReport",
+    "PartitionInput",
+    "contract_fingerprint",
+    "profile_partitioned",
+    "run_incremental",
+    "suggest_partitioned",
     "MetricCalculationException",
     "MetricCalculationPreconditionException",
     "MetricCalculationRuntimeException",

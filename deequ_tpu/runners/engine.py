@@ -124,6 +124,21 @@ class RunMonitor:
     #: partials fold shard-local states, butterfly-merged at the coalesce
     #: drain boundary (service.coalesce._execute_mesh_fold)
     fleet_mesh_folds: int = 0
+    #: incremental verification (runners.incremental): partitions the
+    #: delta planner scheduled a scan for this run (new + invalidated)
+    partitions_scanned: int = 0
+    #: partitions whose stored states were loaded with ZERO data touched
+    partitions_reused: int = 0
+    #: partitions whose stored states went stale (content change,
+    #: fingerprint mismatch, battery growth, corruption) and re-scanned
+    partitions_invalidated: int = 0
+    #: stored partitions absent from the incoming set — excluded from the
+    #: merge (retention deletions show up here)
+    partitions_dropped: int = 0
+    #: partitions whose states were served by the ROLLUP cache (the
+    #: persisted left-fold prefix) — neither their data nor their state
+    #: blobs were touched
+    partitions_rolled_up: int = 0
 
     def reset(self) -> None:
         self.passes = 0
@@ -154,6 +169,11 @@ class RunMonitor:
         self.fast_path_folds = 0
         self.coalesced_folds = 0
         self.fleet_mesh_folds = 0
+        self.partitions_scanned = 0
+        self.partitions_reused = 0
+        self.partitions_invalidated = 0
+        self.partitions_dropped = 0
+        self.partitions_rolled_up = 0
 
     def merge_from(self, other: "RunMonitor") -> None:
         """Absorb another monitor's counters and phase times (locked).
@@ -169,7 +189,9 @@ class RunMonitor:
                 "device_stalls", "device_freq_sets",
                 "freq_overflow_fallbacks", "shard_losses", "mesh_reshards",
                 "salvaged_states", "fast_path_folds", "coalesced_folds",
-                "fleet_mesh_folds", "cost_probes",
+                "fleet_mesh_folds", "cost_probes", "partitions_scanned",
+                "partitions_reused", "partitions_invalidated",
+                "partitions_dropped", "partitions_rolled_up",
             ):
                 setattr(self, name, getattr(self, name) + getattr(other, name))
             self.bundle_dispatch_seconds += other.bundle_dispatch_seconds

@@ -98,6 +98,7 @@ class VerificationService:
         background_warm: bool = True,
         metrics: Optional[ServiceMetrics] = None,
         fleet: Optional[bool] = None,
+        partition_store=None,
     ):
         self.metrics = metrics or ServiceMetrics()
         self.router = PlacementRouter(
@@ -125,6 +126,23 @@ class VerificationService:
         )
         self.state_root = state_root
         self.mesh = mesh
+        # partition-aware incremental verification (ROADMAP item 4): the
+        # service-default PartitionStateStore. Accepts a store instance or
+        # a root path; unset falls back to DEEQU_TPU_PARTITION_STORE (None
+        # when that is unset too). Streaming sessions flush their states
+        # into it as a partition on close, and verify_partitioned below
+        # plans deltas against it.
+        from ..repository.partition_store import (
+            PartitionStateStore,
+            default_partition_store,
+        )
+
+        if partition_store is None:
+            self.partition_store = default_partition_store()
+        elif isinstance(partition_store, str):
+            self.partition_store = PartitionStateStore(partition_store)
+        else:
+            self.partition_store = partition_store
         from .coalesce import FoldCoalescer
 
         #: cross-session fold coalescing + tiny-delta host fast path
@@ -229,6 +247,88 @@ class VerificationService:
         """Blocking convenience: submit + wait for the result."""
         timeout = kw.pop("timeout", None)
         return self.submit_verification(data, checks, **kw).result(timeout)
+
+    # -- partition-aware incremental verification ----------------------------
+
+    def submit_partitioned_verification(
+        self,
+        dataset_name: str,
+        partitions,
+        checks: Sequence[Check],
+        *,
+        checksums=None,
+        required_analyzers: Sequence[Analyzer] = (),
+        tenant: str = "default",
+        priority: Priority = Priority.NORMAL,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 0,
+        batch_size: Optional[int] = None,
+        store=None,
+        metrics_repository: Optional[Any] = None,
+        save_or_append_results_with_key: Optional[Any] = None,
+        delete_dropped: bool = False,
+    ) -> JobHandle:
+        """Queue one INCREMENTAL verification run against the service's
+        partition store: the delta planner diffs ``partitions`` against
+        the store, scans only new/changed partitions — riding the
+        tenant's fleet sub-mesh when the fleet scheduler is on — and
+        merges stored + fresh states into suite metrics. The job's
+        RunMonitor counters (partitions scanned/reused/invalidated/
+        dropped) harvest onto the export plane per tenant.
+
+        ``max_retries`` defaults to 0: a partition scan PERSISTS states
+        and commits manifests as it goes, so a blind re-run after a
+        partial failure re-plans (already-committed partitions reuse) —
+        retrying is safe but rarely what a caller wants implicitly."""
+        from ..verification import VerificationSuite
+
+        target = store if store is not None else self.partition_store
+        if target is None:
+            raise ValueError(
+                "no partition store: pass store=, construct the service "
+                "with partition_store=, or set DEEQU_TPU_PARTITION_STORE"
+            )
+        checks = list(checks)
+        required = list(required_analyzers)
+
+        def run(ctx: JobContext):
+            return VerificationSuite.verify_partitioned(
+                target,
+                dataset_name,
+                partitions,
+                checks,
+                required,
+                checksums=checksums,
+                batch_size=batch_size,
+                monitor=ctx.monitor,
+                # fresh-partition scans shard across the tenant's leased
+                # sub-mesh (fleet default path), the explicit service
+                # mesh, or a single chip — the submit_verification order
+                sharding=ctx.mesh if ctx.mesh is not None else self.mesh,
+                placement=ctx.placement,
+                metrics_repository=metrics_repository,
+                save_or_append_results_with_key=save_or_append_results_with_key,
+                delete_dropped=delete_dropped,
+            )
+
+        return self.scheduler.submit(
+            run,
+            tenant=tenant,
+            priority=priority,
+            deadline_s=deadline_s,
+            max_retries=max_retries,
+            mesh_tenant=tenant if self.fleet is not None else None,
+        )
+
+    def verify_partitioned(
+        self, dataset_name: str, partitions, checks: Sequence[Check], **kw
+    ):
+        """Blocking convenience of
+        :meth:`submit_partitioned_verification`."""
+        timeout = kw.pop("timeout", None)
+        return self.submit_partitioned_verification(
+            dataset_name, partitions, checks, **kw
+        ).result(timeout)
 
     # -- streaming sessions --------------------------------------------------
 

@@ -792,26 +792,82 @@ class FoldCoalescer:
             self._queues.pop(key, None)
         return group
 
+    def _complete_locked(
+        self, pending: _PendingFold, result=None,
+        error: Optional[BaseException] = None,
+    ) -> bool:
+        """Under ``self._lock``: resolve a fold and release its ordering
+        bookkeeping; returns whether this call resolved it (False = it
+        was already DONE)."""
+        if pending.state == _DONE:
+            return False  # a claim-wait failure already resolved it
+        pending.result = result
+        pending.error = error
+        pending.state = _DONE
+        self._inflight.discard(pending.skey)
+        if pending.drainable:
+            self._fifo_remove_locked(pending)
+        else:
+            n = self._serial_barrier.get(pending.skey, 0) - 1
+            if n > 0:
+                self._serial_barrier[pending.skey] = n
+            else:
+                self._serial_barrier.pop(pending.skey, None)
+        return True
+
     def _complete(
         self, pending: _PendingFold, result=None,
         error: Optional[BaseException] = None,
     ) -> None:
         with self._lock:
-            if pending.state == _DONE:
-                return  # a claim-wait failure already resolved it
-            pending.result = result
-            pending.error = error
-            pending.state = _DONE
-            self._inflight.discard(pending.skey)
-            if pending.drainable:
-                self._fifo_remove_locked(pending)
-            else:
-                n = self._serial_barrier.get(pending.skey, 0) - 1
-                if n > 0:
-                    self._serial_barrier[pending.skey] = n
-                else:
-                    self._serial_barrier.pop(pending.skey, None)
+            self._complete_locked(pending, result=result, error=error)
         pending.event.set()
+
+    def reconcile_orphan(self, ctx, pending: _PendingFold, exc):
+        """The fold's job is terminating WITHOUT run_fold having run to
+        completion — a worker fault between pickup and the body, an
+        infrastructure error, a queued-past-deadline kill. Make the
+        fold's COMMIT and its job's FINISH atomic:
+
+        - an UNCLAIMED fold is withdrawn (resolved failed, out of
+          queue/fifo/barrier) so no later drain can execute a fold whose
+          caller was told it failed — the orphan leak that broke the
+          chaos soak's stream_fold_parity (a drain would commit the
+          batch on the session's NEXT ingest, after the failure, and out
+          of order);
+        - a CLAIMED fold waits out the drain that owns it (drains always
+          complete their claims) and the job ADOPTS the outcome: a
+          committed fold makes the job succeed with the committed
+          result.
+
+        Returns None (nothing adopted; fail with the original error) or
+        the fold's ``(result, error)`` outcome. Wired as the fold job's
+        scheduler ``recover_fn``; a scheduler RETRY re-arms a withdrawn
+        fold exactly like any memoized failure (run_fold's attempt>1
+        path)."""
+        with self._lock:
+            if pending.state == _ENQ:
+                self._complete_locked(pending, error=exc)
+                withdrawn = True
+            else:
+                withdrawn = False
+        if withdrawn:
+            pending.event.set()
+            return None
+        # claimed (or already done): the drain owns the outcome
+        deadline = time.monotonic() + self.CLAIM_WAIT_S
+        while pending.state != _DONE and time.monotonic() < deadline:
+            pending.event.wait(self._DRAIN_RECHECK_S)
+        if pending.state != _DONE:
+            self._complete(pending, error=RuntimeError(
+                f"coalesced launch holding fold for {pending.skey} "
+                f"did not complete within {self.CLAIM_WAIT_S:.0f}s"
+            ))
+        if not pending.harvested:
+            pending.harvested = True
+            if ctx is not None:
+                ctx.monitor.merge_from(pending.monitor)
+        return (pending.result, pending.error)
 
     # -- execution -----------------------------------------------------------
 

@@ -7,8 +7,15 @@ admission-controlled queue between callers and the engine. Design points:
 - **Bounded admission.** `submit` sheds with a typed
   :class:`ServiceOverloaded` once `max_queue_depth` jobs are pending —
   queueing unboundedly only converts an overload into a deadline storm.
-  Retries of already-admitted jobs re-enter without re-admission (the
-  bound can transiently exceed by at most the worker count).
+  Retries of already-admitted jobs re-enter without re-admission, so the
+  bound can transiently exceed by the number of jobs concurrently
+  CLAIMED by workers (at most ``workers * _PICK_BATCH``): a pickup frees
+  queue slots that new admissions may take before a claimed job's retry
+  re-enters the delayed queue. Formally, with P = pending, A = claimed,
+  every transition preserves ``P + A <= max_queue_depth + workers *
+  _PICK_BATCH`` — submit requires ``P < max_queue_depth``, pickup moves
+  P->A, a retry moves A->P — so sampled pending never exceeds that sum
+  (pinned by the soak test).
 - **Priority classes.** The ready list stays sorted by (priority,
   submission sequence): strict priority, FIFO within a class.
 - **Deadlines.** Per-job wall-clock budgets, checked when a worker picks
@@ -123,12 +130,13 @@ class _Job:
         "job_id", "fn", "tenant", "priority", "deadline_s", "deadline_abs",
         "submit_time", "max_retries", "retry_backoff_s", "retry_on",
         "signature", "handle", "attempts", "seq", "warm_fn", "serial_key",
-        "span", "defer_key", "mesh_tenant",
+        "span", "defer_key", "mesh_tenant", "recover_fn",
     )
 
     def __init__(self, **kw):
         self.defer_key = None
         self.mesh_tenant = None
+        self.recover_fn = None
         for k, v in kw.items():
             setattr(self, k, v)
         self.attempts = 0
@@ -252,6 +260,32 @@ class JobScheduler:
             "recomputed).",
         )
         self.metrics.describe(
+            "deequ_service_partitions_scanned_total",
+            "Partitions the incremental delta planner scheduled a scan "
+            "for (new + invalidated).",
+        )
+        self.metrics.describe(
+            "deequ_service_partitions_reused_total",
+            "Partitions served from stored algebraic states with zero "
+            "data touched.",
+        )
+        self.metrics.describe(
+            "deequ_service_partitions_invalidated_total",
+            "Stored partitions that went stale (content change, "
+            "fingerprint mismatch, battery growth, corruption) and were "
+            "re-scanned.",
+        )
+        self.metrics.describe(
+            "deequ_service_partitions_dropped_total",
+            "Stored partitions absent from an incoming partition set — "
+            "excluded from the merge by re-merge semantics.",
+        )
+        self.metrics.describe(
+            "deequ_service_partitions_rolled_up_total",
+            "Partitions served by the rollup cache (the persisted "
+            "left-fold prefix) — neither data nor state blobs touched.",
+        )
+        self.metrics.describe(
             "deequ_service_analyzer_cost_seconds_total",
             "Per-analyzer cost attribution: each signature bundle's "
             "measured compile+dispatch seconds split across its slots, "
@@ -307,6 +341,7 @@ class JobScheduler:
         block_s: Optional[float] = None,
         defer_key: Optional[Any] = None,
         mesh_tenant: Optional[str] = None,
+        recover_fn: Optional[Callable[[Any, BaseException], Any]] = None,
     ) -> JobHandle:
         """Admit one job, or shed it with :class:`ServiceOverloaded`.
 
@@ -328,7 +363,18 @@ class JobScheduler:
         leases that tenant's sub-mesh from the fleet scheduler (disjoint
         from other tenants' slices) and hands it to the body as
         ``ctx.mesh``; the lease releases when the attempt ends. Ignored
-        when the scheduler has no fleet (single chip)."""
+        when the scheduler has no fleet (single chip).
+
+        ``recover_fn(ctx, exc)``, if given, is consulted when the job is
+        about to terminate WITHOUT its body having run to completion —
+        a worker fault before the body, an infrastructure error, a
+        queued-past-deadline kill. It returns ``None`` (nothing to
+        adopt; the job fails/times out normally) or a ``(value, error)``
+        outcome the job must adopt instead — the coalescer uses this to
+        keep a fold's COMMIT and its job's FINISH atomic: a drain that
+        already committed the fold makes the job succeed with the
+        committed result, and an unclaimed fold is withdrawn so no later
+        drain can commit a batch whose caller was told it failed."""
         with self._cond:
             if self._closed:
                 raise ServiceClosed("verification service is shut down")
@@ -359,7 +405,7 @@ class JobScheduler:
                 retry_on=tuple(retry_on), signature=signature,
                 handle=handle, seq=seq, warm_fn=warm_fn,
                 serial_key=serial_key, defer_key=defer_key,
-                mesh_tenant=mesh_tenant,
+                mesh_tenant=mesh_tenant, recover_fn=recover_fn,
             )
             # the trace root of the job's whole causal chain: admission,
             # every attempt/retry, placement, the engine passes it runs
@@ -417,12 +463,36 @@ class JobScheduler:
         """The best ready job this worker may run, or None when every ready
         job's serial key is busy (the worker then waits instead of parking
         on a session lock). ``_ready`` is kept sorted, so this is a single
-        front-to-back scan."""
+        front-to-back scan.
+
+        An INELIGIBLE job blocks its later same-serial-key siblings from
+        this scan: skipping a drain-DEFERRED job and picking its sibling
+        would let the sibling's fold claim ahead of it — the serial key is
+        free (neither is running), so ``_eligible`` alone cannot see the
+        ordering violation. This was the cross-key commit-inversion flake:
+        a session alternating micro-batch buckets had fold N deferred
+        under key A's active drain while fold N+1 (key B) was picked and
+        committed first."""
         first = None
+        blocked_keys: set = set()
         for i, entry in enumerate(self._ready):
-            if self._eligible(entry[2]):
+            job_i = entry[2]
+            key = job_i.serial_key
+            if (
+                key is not None
+                and key in blocked_keys
+                # the key's OWNER is exempt: a promoted retry re-enters
+                # with a LATER seq than its queued sibling, and blocking
+                # it behind that (ineligible) sibling would deadlock the
+                # key — the owner is by definition the ordering head
+                and self._running_keys.get(key) is not job_i
+            ):
+                continue
+            if self._eligible(job_i):
                 first = i
                 break
+            if key is not None:
+                blocked_keys.add(key)
         if first is None:
             return None
         # soft affinity: among the best few eligible entries of the same
@@ -433,7 +503,11 @@ class JobScheduler:
         chosen = first
         scanned = 0
         inspected = 0
-        keys_seen: set = set()
+        # seed with the keys the first-eligible scan blocked: a job whose
+        # earlier same-key sibling is deferred must not be AFFINITY-
+        # promoted either, or the promotion re-opens the cross-key
+        # commit-inversion hole the blocked_keys rule closes
+        keys_seen: set = set(blocked_keys)
         for j in range(first, len(self._ready)):
             entry = self._ready[j]
             inspected += 1
@@ -510,10 +584,14 @@ class JobScheduler:
             # unresolved forever — "every job terminates with a result
             # or a typed error" includes scheduler-infrastructure bugs
             if not job.handle.done():
-                self._finish(
-                    job, None, JobFailed(job.job_id, job.attempts, exc),
-                    outcome="failed",
-                )
+                adopted = self._recover(job, None, exc)
+                if adopted is not None and adopted[1] is None:
+                    self._finish(job, adopted[0], None, outcome="success")
+                else:
+                    self._finish(
+                        job, None, JobFailed(job.job_id, job.attempts, exc),
+                        outcome="failed",
+                    )
         finally:
             with self._cond:
                 self._active -= 1
@@ -547,11 +625,14 @@ class JobScheduler:
             job.span.add_event(
                 "queued_past_deadline", waited_s=now - job.submit_time
             )
-            self._finish(
-                job, None,
-                JobTimeout(job.job_id, job.deadline_s, now - job.submit_time),
-                outcome="timeout",
+            timeout = JobTimeout(
+                job.job_id, job.deadline_s, now - job.submit_time
             )
+            # a deadline-killed fold job never ran its body: withdraw the
+            # pending fold (releasing the session's serial barrier) so it
+            # cannot linger claimable after its caller was told timeout
+            self._recover(job, None, timeout)
+            self._finish(job, None, timeout, outcome="timeout")
             return False
         job.attempts += 1
         job.span.add_event(
@@ -590,7 +671,21 @@ class JobScheduler:
                 value = job.fn(ctx)
             except BaseException as exc:  # noqa: BLE001 - routed into
                 # the taxonomy below
+                # commit/job-finish atomicity: before failing a job whose
+                # BODY may not have run (an injected worker fault fires
+                # between pickup and fn), let its recover_fn reconcile —
+                # a coalesced drain that already committed the job's fold
+                # makes the job SUCCEED with the committed result, and an
+                # unclaimed fold is withdrawn so no later drain can
+                # commit work the caller was told failed (the chaos
+                # soak's stream_fold_parity invariant)
+                adopted = self._recover(job, ctx, exc)
                 self._harvest(job, ctx)
+                if adopted is not None and adopted[1] is None:
+                    self._finish(job, adopted[0], None, outcome="success")
+                    return False
+                if adopted is not None:
+                    exc = adopted[1]
                 if self._maybe_retry(job, exc):
                     return True  # worker keeps the serial key (FIFO)
                 if isinstance(exc, ServiceError) and not isinstance(
@@ -633,6 +728,22 @@ class JobScheduler:
             return False
         self._finish(job, value, None, outcome="success")
         return False
+
+    def _recover(self, job: _Job, ctx, exc: BaseException):
+        """Consult the job's recover_fn (see `submit`); defensive — a
+        raising recover_fn must not mask the original failure."""
+        if job.recover_fn is None:
+            return None
+        try:
+            return job.recover_fn(ctx, exc)
+        except BaseException:  # noqa: BLE001 - keep the original error
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "recover_fn for job %s raised; keeping the original "
+                "failure", job.job_id, exc_info=True,
+            )
+            return None
 
     def finish_absorbed(self, absorbed) -> None:
         """Resolve jobs whose WORK was already executed by a coalesced
@@ -758,6 +869,21 @@ class JobScheduler:
                 ("deequ_service_isolation_reruns_total",
                  float(monitor.isolation_reruns), tenant_label)
             )
+        # incremental verification: the delta planner's per-run partition
+        # decisions, per tenant — the export-plane record of how much data
+        # the state reuse actually saved
+        for field_name, series in (
+            ("partitions_scanned", "deequ_service_partitions_scanned_total"),
+            ("partitions_reused", "deequ_service_partitions_reused_total"),
+            ("partitions_invalidated",
+             "deequ_service_partitions_invalidated_total"),
+            ("partitions_dropped", "deequ_service_partitions_dropped_total"),
+            ("partitions_rolled_up",
+             "deequ_service_partitions_rolled_up_total"),
+        ):
+            value = getattr(monitor, field_name)
+            if value:
+                updates.append((series, float(value), tenant_label))
         if monitor.degraded:
             updates.append(
                 ("deequ_service_degraded_analyzers_total",

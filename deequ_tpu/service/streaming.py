@@ -334,6 +334,16 @@ class StreamingSession:
                         if pending is None and serial_mesh is not None
                         else None
                     ),
+                    # commit/job-finish atomicity: a job that dies OUTSIDE
+                    # the fold body (worker fault, deadline kill in queue)
+                    # reconciles with the coalescer — adopting a drain's
+                    # committed result, or withdrawing the unclaimed fold
+                    # so no later drain can commit it after the failure
+                    recover_fn=(
+                        (lambda ctx, exc, _p=pending:
+                         coalescer.reconcile_orphan(ctx, _p, exc))
+                        if pending is not None else None
+                    ),
                 )
             except BaseException:
                 if pending is not None:
@@ -688,9 +698,84 @@ class StreamingSession:
     def closed(self) -> bool:
         return self._closed
 
+    def flush_to_partition(
+        self, store=None, partition: Optional[str] = None
+    ) -> Optional[str]:
+        """Flush the session's cumulative algebraic states into a
+        partition store as ONE partition of ``self.dataset`` — the bridge
+        from the streaming plane to incremental verification: a finished
+        ingestion window becomes a reusable partition, and moving the
+        session to another host (ROADMAP item 3) is a flush + re-open,
+        not a re-scan. Returns the partition name (None when the session
+        never folded a batch).
+
+        Called under the serial lock by :meth:`close` when the service
+        has a partition store; callable explicitly mid-life too (each
+        flush overwrites the session's partition with the newest
+        cumulative states and a version token derived from the fold
+        counters)."""
+        with self._serial:
+            return self._flush_to_partition_locked(store, partition)
+
+    def _flush_to_partition_locked(self, store=None, partition=None):
+        store = store if store is not None else getattr(
+            self.service, "partition_store", None
+        )
+        if store is None or self.batches_ingested == 0 or self._schema is None:
+            return None
+        from ..integrity import checksum_json
+        from ..observability import trace as _trace
+        from ..runners.incremental import analyzer_key, contract_fingerprint
+
+        name = partition or f"session-{self.tenant}"
+        keys = []
+        provider = store.provider(self.dataset, name)
+        store.invalidate(self.dataset, name)
+        for a in self._analyzers:
+            state = self.provider.load(a)
+            if state is None:
+                continue
+            provider.persist(a, state)
+            keys.append(analyzer_key(a))
+        store.commit(
+            self.dataset, name,
+            fingerprint=contract_fingerprint(self._schema),
+            # the version token: a deterministic digest of the fold
+            # counters — a re-flush after more folds reads as changed
+            content_checksum=checksum_json({
+                "batches": self.batches_ingested,
+                "rows": self.rows_ingested,
+                "bytes": self.bytes_ingested,
+            }),
+            num_rows=self.rows_ingested,
+            analyzer_keys=keys,
+            schema=[
+                (c.name, c.kind.value) for c in self._schema.columns
+            ],
+        )
+        _trace.add_event(
+            "session_flushed_to_partition", dataset=self.dataset,
+            partition=name, rows=self.rows_ingested,
+        )
+        return name
+
     def close(self) -> None:
         with self._serial:
+            if self._closed:
+                return
             self._closed = True
+            # a session backed by a service-level partition store flushes
+            # its cumulative states as a partition on close: the window
+            # it verified becomes reusable input for incremental runs
+            # (best-effort — closing must never fail on a full disk)
+            try:
+                self._flush_to_partition_locked()
+            except Exception:  # noqa: BLE001 - flush is an optimization
+                _logger.warning(
+                    "could not flush session %s/%s states to the "
+                    "partition store", self.tenant, self.dataset,
+                    exc_info=True,
+                )
 
 
 def session_key(tenant: str, dataset: str) -> Tuple[str, str]:

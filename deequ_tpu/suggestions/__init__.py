@@ -65,6 +65,24 @@ class ConstraintSuggestionResult:
         )
 
 
+def apply_rules(profiles, constraint_rules) -> ConstraintSuggestionResult:
+    """Rule application alone: profiles in, suggestions out. Shared by the
+    one-shot runner below and the incremental plane
+    (`runners.incremental.suggest_partitioned`), which feeds it profiles
+    computed from stored partition states."""
+    suggestions: List[ConstraintSuggestion] = []
+    for profile in profiles.profiles.values():
+        for rule in constraint_rules:
+            if rule.should_be_applied(profile, profiles.num_records):
+                suggestions.append(rule.candidate(profile, profiles.num_records))
+    by_column: Dict[str, List[ConstraintSuggestion]] = {}
+    for s in suggestions:
+        by_column.setdefault(s.column_name, []).append(s)
+    return ConstraintSuggestionResult(
+        profiles.profiles, profiles.num_records, by_column
+    )
+
+
 class ConstraintSuggestionRunner:
     @staticmethod
     def on_data(data) -> "ConstraintSuggestionRunBuilder":
@@ -116,24 +134,16 @@ class ConstraintSuggestionRunner:
             batch_size=batch_size,
         )
 
-        suggestions: List[ConstraintSuggestion] = []
-        for profile in profiles.profiles.values():
-            for rule in constraint_rules:
-                if rule.should_be_applied(profile, profiles.num_records):
-                    suggestions.append(rule.candidate(profile, profiles.num_records))
+        # per-profile (= per-column) rule application: the flat list's
+        # order equals all_suggestions' grouped order, since each
+        # profile's suggestions are contiguous
+        result = apply_rules(profiles, constraint_rules)
+        suggestions = result.all_suggestions
 
         from .. import io as dio
 
         if profiles_path is not None:
             dio.write_text_atomic(profiles_path, profiles.to_json())
-
-        by_column: Dict[str, List[ConstraintSuggestion]] = {}
-        for s in suggestions:
-            by_column.setdefault(s.column_name, []).append(s)
-
-        result = ConstraintSuggestionResult(
-            profiles.profiles, profiles.num_records, by_column
-        )
         if suggestions_path is not None:
             dio.write_text_atomic(suggestions_path, result.to_json())
 

@@ -805,6 +805,137 @@ class TestOrderingAcrossKeys:
             svc.close()
 
 
+class TestCommitFinishAtomicity:
+    """A fold's COMMIT and its job's FINISH are atomic with respect to
+    worker-fault injection (the chaos soak's stream_fold_parity flake):
+    a job killed OUTSIDE the fold body must withdraw an unclaimed fold —
+    no later drain may commit a batch the caller was told failed — or
+    adopt the outcome of a drain that already claimed it."""
+
+    def test_worker_fault_withdraws_unclaimed_fold(self, monkeypatch):
+        """Pre-fix: the orphaned fold lingered claimable and the NEXT
+        ingest's drain committed it — after its failure, and out of
+        order (seed tree measured batches_ingested=2, sizes
+        [700, 1212])."""
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.service.errors import JobFailed
+
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=2, background_warm=False)
+        try:
+            s = svc.session("t", "orphan", _checks())
+            with inject(FaultSpec("worker", "worker_death", at=1)) as inj:
+                h1 = s.ingest(_table(512, 1), wait=False)
+                with pytest.raises(JobFailed):
+                    h1.result(60)
+            assert inj.fired
+            s.ingest(_table(700, 2), timeout=60)
+            time.sleep(0.3)  # any stray drain would misbehave here
+            assert s.batches_ingested == 1
+            sizes = [
+                m.value.get()
+                for r in s.results
+                for a, m in r.metrics.items()
+                if a.name == "Size"
+            ]
+            assert sizes == [700.0], sizes
+        finally:
+            svc.close()
+
+    def test_job_adopts_drain_committed_outcome(self, monkeypatch):
+        """White-box: the fold was CLAIMED by another worker's drain when
+        its own job died pre-body — reconcile waits the claim out and
+        adopts the committed result (the job then finishes success)."""
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=0, background_warm=False)
+        try:
+            co = svc.coalescer
+            from deequ_tpu.ingest.columnar import as_dataset
+
+            s = svc.session("t", "adopt", _checks())
+            p = co.prepare(s, as_dataset(_table(256, 3)), 1024)
+            co.mark_submitted(p)
+            with co._lock:
+                group = co._claim_group_locked(p)
+            assert group == [p]
+            out = []
+            t = threading.Thread(
+                target=lambda: out.append(
+                    co.reconcile_orphan(None, p, RuntimeError("crash"))
+                )
+            )
+            t.start()
+            time.sleep(0.2)
+            assert not out, "reconcile must wait for the claim owner"
+            co._complete(p, result="committed-by-drain")
+            t.join(10)
+            assert out == [("committed-by-drain", None)]
+        finally:
+            svc.close()
+
+    def test_withdrawn_fold_invisible_to_sweeps(self, monkeypatch):
+        monkeypatch.setenv(COALESCE_ENV, "1")
+        svc = VerificationService(workers=0, background_warm=False)
+        try:
+            co = svc.coalescer
+            from deequ_tpu.ingest.columnar import as_dataset
+
+            s = svc.session("t", "withdraw", _checks())
+            p = co.prepare(s, as_dataset(_table(256, 4)), 1024)
+            co.mark_submitted(p)
+            assert co.reconcile_orphan(
+                None, p, RuntimeError("crash")
+            ) is None
+            assert p.error is not None
+            with co._lock:
+                assert co._claim_sweep_locked(p.key) == []
+            # the session's fifo released: a later fold is drainable
+            p2 = co.prepare(s, as_dataset(_table(256, 5)), 1024)
+            co.mark_submitted(p2)
+            with co._lock:
+                assert co._claim_group_locked(p2) == [p2]
+        finally:
+            svc.close()
+
+    def test_deferred_sibling_blocks_cross_key_pickup(self):
+        """The _pick ordering rule behind the mixed-bucket inversion fix:
+        an INELIGIBLE (drain-deferred) job blocks later same-serial-key
+        jobs from pickup — skipping it would let fold N+1 claim and
+        commit ahead of fold N."""
+        from deequ_tpu.service import battery_signature
+        from deequ_tpu.service.scheduler import JobScheduler
+
+        sched = JobScheduler(workers=0, max_queue_depth=16)
+        try:
+            ran = []
+            # j2 carries a signature worker 0 is WARM for: the affinity
+            # promotion path must honor the blocked key exactly like the
+            # first-eligible scan (it used to re-open the inversion)
+            sig = battery_signature([Mean("deferred_affinity_col")])
+            sched.router.note_ran(sig, 0, placement="device")
+            sched.defer_pickup("keyA")
+            sched.submit(lambda ctx: ran.append(1), serial_key="s",
+                         defer_key="keyA", job_id="j1")
+            sched.submit(lambda ctx: ran.append(3), serial_key="other",
+                         job_id="j3")
+            sched.submit(lambda ctx: ran.append(2), serial_key="s",
+                         defer_key="keyB", signature=sig, job_id="j2")
+            with sched._lock:
+                picked = sched._pick(0)
+            # j1 deferred -> j2 (same serial key) must NOT be picked —
+            # neither as first-eligible nor by affinity promotion; the
+            # unrelated j3 is
+            assert picked is not None and picked.job_id == "j3"
+            with sched._lock:
+                assert sched._pick(0) is None
+            sched.resume_pickup("keyA")
+            with sched._lock:
+                picked = sched._pick(0)
+            assert picked.job_id == "j1"
+        finally:
+            sched.shutdown(wait=False)
+
+
 class TestRetrySemantics:
     def test_failed_fold_reexecutes_on_retry(self, monkeypatch):
         """A memoized FAILURE must re-run on a scheduler retry (the

@@ -15,7 +15,10 @@ Tracked per stage:
   points, streaming-knee sessions/s;
 - **memory** (lower is better): grouping/spill peak RSS;
 - **compile counts** (must not increase): each stage's ``compiles`` field
-  — a warm stage recompiling is a regression at ANY throughput.
+  — a warm stage recompiling is a regression at ANY throughput;
+- **incremental verification** (ISSUE 13): the +1%-growth point's
+  full-scan speedup and reuse ratio (higher-better) and its cost as a
+  fraction of the full scan (lower-better).
 
 Substrate guard: scaling numbers measured on the 8-virtual-CPU-device
 fallback model nothing about an accelerator mesh (the r06
@@ -65,6 +68,13 @@ _SCALARS: List[Tuple[str, str, str]] = [
     ("streaming_knee", "streaming_knee_sessions_per_s", "throughput"),
     ("grouping", "grouping_peak_rss_gb", "rss"),
     ("spill", "spill_peak_rss_gb", "rss"),
+    # incremental verification (ISSUE 13): the +1%-growth point's
+    # full-scan speedup and reuse ratio must not rot (higher-better), and
+    # its cost fraction of the full scan must not grow (lower-better —
+    # gated with the rss comparator)
+    ("incremental", "incremental_speedup_vs_full", "throughput"),
+    ("incremental", "incremental_reuse_ratio", "throughput"),
+    ("incremental", "incremental_cost_fraction", "rss"),
 ]
 
 

@@ -171,6 +171,7 @@ def run_soak(
                 session.batches_ingested == summary["stream_folds_ok"]
             )
             summary["repo_drill"] = _repository_drill(data, state_root)
+            summary["partition_drill"] = _partition_drill(data, state_root)
             summary["mesh_drill"] = _mesh_drill(data)
             summary["ingest_drill"] = _ingest_drill(service)
             summary["coalesce_drill"] = _coalesce_drill(service)
@@ -192,6 +193,7 @@ def run_soak(
         "jobs_accounted":
             summary["succeeded"] + summary["typed_failures"] == jobs,
         "repo_drill": summary["repo_drill"]["ok"],
+        "partition_drill": summary["partition_drill"]["ok"],
         "mesh_drill": summary["mesh_drill"]["ok"],
         "ingest_drill": summary["ingest_drill"]["ok"],
         "coalesce_drill": summary["coalesce_drill"]["ok"],
@@ -547,6 +549,101 @@ def _ingest_drill(service) -> Dict:
         and out["corrupt_typed"] and out["corrupt_committed"] == 0
         and out["injected_typed"] and out["injected_committed"] == 1
     )
+    return out
+
+
+def _partition_drill(data, tmpdir: str) -> Dict:
+    """Incremental-verification corruption drill (ISSUE 13 acceptance): a
+    partitioned table's stored states take (1) a flipped byte inside one
+    partition's state blob and (2) a schema change flipping the contract
+    fingerprint. Both must degrade TYPED — the corrupt partition
+    quarantines and re-scans ALONE (siblings reuse, metrics equal to the
+    clean merge), and the stale fingerprint invalidates without
+    crashing. ``inject()`` swaps the soak's ambient plan out so an
+    ambient hit cannot shift the pinned plan decisions."""
+    import glob
+    import os
+
+    import pyarrow as pa
+
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.reliability import inject
+    from deequ_tpu.repository.partition_store import (
+        PartitionStateStore,
+        partition_quarantined_total,
+    )
+    from deequ_tpu.runners.engine import RunMonitor
+    from deequ_tpu.runners.incremental import run_incremental
+
+    from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+
+    out: Dict = {}
+    with inject():
+        store = PartitionStateStore(os.path.join(tmpdir, "partition-store"))
+        analyzers = [Size(), Completeness("x"), Mean("x"), Sum("y")]
+        rows = int(data.num_rows)
+        third = rows // 3
+        parts = {
+            f"p{i}": Dataset.from_arrow(data.arrow.slice(i * third, third))
+            for i in range(3)
+        }
+        clean_ctx, first = run_incremental(
+            store, "drill", parts, analyzers, batch_size=third,
+        )
+        out["first_scan"] = list(first.plan.scan)
+
+        # (1) corrupt one partition's Mean blob. The rollup cache is
+        # dropped first so the merge actually reads the partition blobs —
+        # with the cache intact the corruption would simply be masked
+        # (tests/test_incremental.py pins that separately)
+        store.rollup_invalidate("drill")
+        [blob] = glob.glob(os.path.join(
+            store.path, "ds-drill", "*", "p-p1", "Mean-*-state.npz"
+        ))
+        raw = bytearray(open(blob, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        open(blob, "wb").write(bytes(raw))
+        before = partition_quarantined_total()
+        mon = RunMonitor()
+        ctx, rep = run_incremental(
+            store, "drill", parts, analyzers, batch_size=third,
+            monitor=mon,
+        )
+        out["corrupt_reason"] = rep.plan.reasons.get("p1")
+        out["corrupt_rescans"] = list(rep.plan.scan)
+        out["corrupt_reused"] = sorted(rep.plan.reuse)
+        out["quarantined"] = partition_quarantined_total() - before
+        parity = all(
+            ctx.metric(a).value.get() == clean_ctx.metric(a).value.get()
+            for a in analyzers
+        )
+        out["parity"] = parity
+
+        # (2) stale fingerprint: same names, changed schema -> every
+        # partition invalidates typed (no crash, no stale merge)
+        import numpy as np
+
+        renamed = {
+            name: Dataset.from_arrow(
+                d.arrow.rename_columns(
+                    ["x2" if c == "x" else c for c in d.arrow.column_names]
+                )
+            )
+            for name, d in parts.items()
+        }
+        ctx2, rep2 = run_incremental(
+            store, "drill", renamed,
+            [Size(), Completeness("x2")], batch_size=third,
+        )
+        out["stale_reasons"] = sorted(set(rep2.plan.reasons.values()))
+        out["ok"] = (
+            out["corrupt_reason"] == "corrupt-state"
+            and out["corrupt_rescans"] == ["p1"]
+            and out["corrupt_reused"] == ["p0", "p2"]
+            and out["quarantined"] >= 1
+            and parity
+            and out["stale_reasons"] == ["stale-fingerprint"]
+        )
     return out
 
 
