@@ -91,19 +91,18 @@ class _SpillStore:
     _COUNT = "__deequ_count__"
 
     def __init__(self, group_columns: Sequence[str]):
-        import os
         import shutil
         import tempfile
         import weakref
 
         self.group_columns = list(group_columns)
         self._key_cols = [f"__deequ_key{i}__" for i in range(len(self.group_columns))]
-        try:
-            self.partitions = max(
-                1, int(os.environ.get(FREQ_SPILL_PARTITIONS_ENV, _DEFAULT_SPILL_PARTITIONS))
-            )
-        except ValueError:
-            self.partitions = _DEFAULT_SPILL_PARTITIONS
+        from ..utils import env_number
+
+        self.partitions = env_number(
+            FREQ_SPILL_PARTITIONS_ENV, _DEFAULT_SPILL_PARTITIONS, int,
+            minimum=1,
+        )
         self.dir = tempfile.mkdtemp(prefix="deequ-tpu-freq-spill-")
         self._runs = 0
         self.entries_spilled = 0
@@ -329,17 +328,14 @@ class FrequenciesAndNumRows:
         return len(self._merged) == 0
 
     def _budget(self) -> int:
-        import os
+        from ..utils import env_number
 
-        try:
-            return int(os.environ.get(FREQ_BUDGET_ENV, "0"))
-        except ValueError:
-            return 0
+        return env_number(FREQ_BUDGET_ENV, 0, int, minimum=0)
 
     def _spill_enabled(self) -> bool:
-        import os
+        from ..utils import env_flag
 
-        return os.environ.get(FREQ_SPILL_ENV, "1") != "0"
+        return env_flag(FREQ_SPILL_ENV, True)
 
     def _flush(self) -> None:
         if not self._runs:

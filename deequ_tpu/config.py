@@ -184,6 +184,52 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Engine placement / host tier / profiling (implemented in
+# deequ_tpu.runners.engine + .analysis_runner; documented here with the
+# other operator-facing switches — the invariant linter's env-knob check
+# (tools/statlint) requires every DEEQU_TPU_* knob read anywhere in the
+# package to be discoverable from this file).
+#
+# - DEEQU_TPU_PLACEMENT: default ingest-tier placement when a run passes
+#   none — "auto" (probe the feed link), "host", or "device".
+# - DEEQU_TPU_HOST_TIER_WORKERS: host ingest tier partial-worker pool
+#   size (default: all cores; 0/unset = default; warn-and-fallback).
+# - DEEQU_TPU_DEVICE_FEATURE_CACHE: HBM budget in GB for the
+#   device-resident feature cache; unset/"0" disables (warn-and-fallback).
+# - DEEQU_TPU_PROFILE_DIR: directory receiving a jax.profiler trace of
+#   every pass; unset = profiling off.
+# - DEEQU_TPU_NO_NATIVE: "1" disables the native host kernels entirely
+#   (pure-Python fallbacks); read at deequ_tpu.native.lib import.
+# - DEEQU_TPU_ADAPTIVE_DICT_ENCODE: "0" disables ingest-time adaptive
+#   dictionary encoding of low-cardinality string columns (data module).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# Host group-by spill tier (implemented in deequ_tpu.analyzers.grouping's
+# host accumulator; documented here for discoverability). All three follow
+# the warn-and-fallback convention via utils.env_number/env_flag.
+#
+# - DEEQU_TPU_MAX_FREQUENCY_ENTRIES: host frequency-table entry budget
+#   before the accumulator spills to disk (0 = unbounded, the default).
+# - DEEQU_TPU_FREQUENCY_SPILL: "0" disables the disk spill tier (the
+#   budget then degrades the analyzer instead of spilling).
+# - DEEQU_TPU_FREQUENCY_SPILL_PARTITIONS: hash partitions of the spill
+#   store's disk layout (default 64; minimum 1).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection (implemented in deequ_tpu.reliability.faults;
+# documented here for discoverability — tools/chaos_soak.py drives these).
+#
+# - DEEQU_TPU_FAULTS: JSON list of FaultSpec dicts arming a process-wide
+#   fault plan. Deliberately NOT warn-and-fallback: a chaos plan that does
+#   not parse must raise, not silently run the drill fault-free.
+# - DEEQU_TPU_FAULT_SEED: rng seed for p-based fault specs (default 0).
+#   Same raise-loudly contract as the plan: a bad seed would silently
+#   change the drill's deterministic fault sequence.
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Scan watchdog (implemented in deequ_tpu.reliability.watchdog; the env
 # knob is documented here with the other operator-facing switches)
 # ---------------------------------------------------------------------------

@@ -520,9 +520,9 @@ def _maybe_dictionary_encode(table: "pa.Table") -> "pa.Table":
     column's DataType+HLL host cost drops ~30x. Columns whose probe looks
     high-cardinality stay as-is (encoding them would waste memory for no
     reuse). Disable with DEEQU_TPU_ADAPTIVE_DICT_ENCODE=0."""
-    import os
+    from ..utils import env_flag
 
-    if os.environ.get(ADAPTIVE_DICT_ENCODE_ENV, "1") == "0":
+    if not env_flag(ADAPTIVE_DICT_ENCODE_ENV, True):
         return table
     n = table.num_rows
     if n == 0:

@@ -247,3 +247,29 @@ def wrap_if_necessary(exception: BaseException) -> MetricCalculationException:
     wrapped = MetricCalculationRuntimeException(str(exception))
     wrapped.__cause__ = exception
     return wrapped
+
+
+#: Typed exceptions that LIVE next to their subsystem (import cycles or
+#: cohesion keep them out of this module) but are part of the package's
+#: failure taxonomy: each is importable from here lazily, and the invariant
+#: linter (tools/statlint, failure-registry check) requires every exception
+#: class defined outside the registry modules (this file, service/errors.py,
+#: runners/exceptions.py, reliability/faults.py) to be listed in this
+#: mapping — a typed failure nobody can discover is not typed.
+_SUBSYSTEM_EXCEPTIONS = {
+    "SerializationError": "deequ_tpu.repository.serde",
+    "ExpressionError": "deequ_tpu.expr",
+    "FrequencyBudgetExceeded": "deequ_tpu.analyzers.grouping",
+    "MeshExhaustedError": "deequ_tpu.parallel.elastic",
+}
+
+
+def __getattr__(name: str):
+    """PEP 562 lazy re-export of the subsystem exceptions (eager imports
+    here would cycle: every subsystem imports this module)."""
+    target = _SUBSYSTEM_EXCEPTIONS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

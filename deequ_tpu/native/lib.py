@@ -9,14 +9,17 @@ buffers. Falls back to pure Python upstream if anything here fails to load.
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 import pyarrow as pa
 
+from ..utils import env_flag
 from .build import build
 
-if os.environ.get("DEEQU_TPU_NO_NATIVE"):
+#: env var: set to 1 to disable the native kernels (pure-Python fallback)
+NO_NATIVE_ENV = "DEEQU_TPU_NO_NATIVE"
+
+if env_flag(NO_NATIVE_ENV, False):
     raise ImportError("native kernels disabled via DEEQU_TPU_NO_NATIVE")
 
 _lib = ctypes.CDLL(build())

@@ -58,15 +58,13 @@ _DEFAULT_RING = 4096
 
 
 def ring_capacity() -> int:
+    from ..utils import env_number
     from .trace import TRACE_RING_ENV
 
-    raw = os.environ.get(TRACE_RING_ENV)
-    if raw is None:
-        return _DEFAULT_RING
-    try:
-        return max(int(raw), 16)
-    except ValueError:
-        return _DEFAULT_RING
+    # clamp (not reject) below-minimum values: an operator capping trace
+    # memory with a tiny ring must get the 16-entry floor, never a silent
+    # fallback to the 4096 default; unparseable values warn once
+    return max(16, env_number(TRACE_RING_ENV, _DEFAULT_RING, int))
 
 
 class FlightRecorder:
@@ -161,7 +159,9 @@ class FlightRecorder:
     # -- dumping -------------------------------------------------------------
 
     def directory(self) -> str:
-        env = os.environ.get(FLIGHT_DIR_ENV)
+        from ..utils import env_str
+
+        env = env_str(FLIGHT_DIR_ENV)
         if env:
             os.makedirs(env, exist_ok=True)
             return env

@@ -87,6 +87,12 @@ class KLLSketchState:
     def capacity(self) -> int:
         return self.sketch_size
 
+    def merge(self, other: "KLLSketchState") -> "KLLSketchState":
+        """Semigroup merge (delegates to :func:`kll_merge`): every *State
+        class exposes the algebra uniformly so generic fold/merge paths —
+        and the state-algebra invariant check — can rely on it."""
+        return kll_merge(self, other)
+
 
 def kll_init(sketch_size: int = DEFAULT_SKETCH_SIZE, levels: int = MAX_LEVELS) -> KLLSketchState:
     k = int(sketch_size)

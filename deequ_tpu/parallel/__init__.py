@@ -24,7 +24,7 @@ partitioned merge path (`AnalysisRunner.run_on_aggregated_states`).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

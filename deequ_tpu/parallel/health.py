@@ -29,7 +29,6 @@ convention: unparseable values warn once and keep the default; any value
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import List, Optional
@@ -44,28 +43,13 @@ _logger = logging.getLogger(__name__)
 HEARTBEAT_ENV = "DEEQU_TPU_SHARD_HEARTBEAT_S"
 DEFAULT_HEARTBEAT_S = 5.0
 
-#: warn-once latch for an unparseable env override
-_ENV_WARNED = False
-
-
 def shard_heartbeat_s() -> Optional[float]:
     """The configured heartbeat interval in seconds, or ``None`` when the
-    periodic heartbeat is disabled (value <= 0)."""
-    raw = os.environ.get(HEARTBEAT_ENV)
-    if raw is None:
-        return DEFAULT_HEARTBEAT_S
-    try:
-        value = float(raw)
-    except ValueError:
-        global _ENV_WARNED
-        if not _ENV_WARNED:
-            _ENV_WARNED = True
-            _logger.warning(
-                "ignoring unparseable %s=%r (expected seconds as a number); "
-                "keeping the default %.1fs heartbeat",
-                HEARTBEAT_ENV, raw, DEFAULT_HEARTBEAT_S,
-            )
-        return DEFAULT_HEARTBEAT_S
+    periodic heartbeat is disabled (value <= 0). Unparseable values warn
+    once and keep the default (the shared ``env_number`` convention)."""
+    from ..utils import env_number
+
+    value = env_number(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S, float)
     return value if value > 0 else None
 
 

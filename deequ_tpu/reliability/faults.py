@@ -37,6 +37,8 @@ site                 fires
 ``repository_load``  in the FS metrics repository's read-all, tag = path
 ``partition_store_load``  in PartitionStateStore.get, tag = dataset/partition
 ``stream_fold``      before a streaming session's fold mutates state
+``coalesced_fold``   before a coalesced fast/device/fleet fold executes a
+                     claimed group, tag = session key
 ``shard_probe``      per mesh shard in the heartbeat health probe, tag = shard
 ``frame_decode``     per ingest-plane frame before it folds, tag = frame idx
 ``prefetch``         per staged batch in the device feed pipeline, tag = idx
@@ -66,7 +68,6 @@ without raising and was declared lost by the heartbeat deadline.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -154,6 +155,34 @@ FAULT_KINDS = (
     "stall", "corrupt", "drift", "mesh_loss", "shard_stall",
     "frame_corrupt", "feed_stall",
 )
+
+#: The fault-site REGISTRY: every ``fault_point(site, ...)`` planted in the
+#: package must name a site listed here, and every site listed here must
+#: have at least one live probe — both directions are machine-checked by
+#: the invariant linter (tools/statlint, failure-registry check), so the
+#: docstring table above and the chaos tooling can rely on this tuple as
+#: ground truth instead of a grep.
+KNOWN_FAULT_SITES = frozenset({
+    "analyzer",
+    "device_update",
+    "compile",
+    "device_feed",
+    "host_partial",
+    "ingest_fold",
+    "state_fetch",
+    "sharded_fold",
+    "collective_merge",
+    "worker",
+    "checkpoint",
+    "state_load",
+    "repository_load",
+    "partition_store_load",
+    "stream_fold",
+    "coalesced_fold",
+    "shard_probe",
+    "frame_decode",
+    "prefetch",
+})
 
 
 @dataclass
@@ -270,11 +299,16 @@ def active_injector() -> Optional[FaultInjector]:
     global _ACTIVE, _ENV_CHECKED
     if _ACTIVE is None and not _ENV_CHECKED:
         _ENV_CHECKED = True
-        env = os.environ.get(FAULTS_ENV)
+        from ..utils import env_str
+
+        env = env_str(FAULTS_ENV)
         if env:
             specs = [FaultSpec.from_dict(d) for d in json.loads(env)]
+            # deliberately NOT warn-and-fallback (like the plan itself): a
+            # chaos drill with an unparseable seed must abort loudly, not
+            # silently run a different fault sequence under seed 0
             _ACTIVE = FaultInjector(
-                specs, seed=int(os.environ.get(FAULT_SEED_ENV, "0"))
+                specs, seed=int(env_str(FAULT_SEED_ENV, "0"))
             )
     return _ACTIVE
 

@@ -87,9 +87,9 @@ def partition_window_months() -> int:
 def default_partition_store(monitor: Optional[Any] = None):
     """The process-default store from ``DEEQU_TPU_PARTITION_STORE``, or
     None when the env var is unset."""
-    import os
+    from ..utils import env_str
 
-    path = os.environ.get(PARTITION_STORE_ENV)
+    path = env_str(PARTITION_STORE_ENV)
     if not path:
         return None
     return PartitionStateStore(path, monitor=monitor)

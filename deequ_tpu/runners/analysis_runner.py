@@ -606,10 +606,10 @@ def _device_tier_expected(scanning, placement) -> bool:
     in-place group-by is strictly better). Delegates to the engine's own
     ``resolve_scan_placement`` so the gate can never drift from where the
     pass actually runs."""
-    import os
-
+    from ..utils import env_str
     from .engine import (
         _FEED_BANDWIDTH_THRESHOLD_MBPS,
+        PLACEMENT_ENV,
         probe_feed_bandwidth,
         resolve_scan_placement,
     )
@@ -619,7 +619,7 @@ def _device_tier_expected(scanning, placement) -> bool:
     # no scan battery to ride: adding the (device-only) frequency scans
     # would CREATE a device pass, which only pays off when the feed link
     # is fast or the caller explicitly asked for the device tier
-    effective = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+    effective = placement or env_str(PLACEMENT_ENV, "auto")
     if effective == "host":
         return False
     if effective == "device":

@@ -34,6 +34,14 @@ from .features import FeatureBuilder
 
 _logger = logging.getLogger(__name__)
 
+#: env var overriding the default ingest-tier placement ("auto" when unset;
+#: "host"/"device" pin the tier). Read through `utils.env_str` so the
+#: env-knob convention check (tools/statlint) can see every read site.
+PLACEMENT_ENV = "DEEQU_TPU_PLACEMENT"
+
+#: env var: directory receiving a `jax.profiler` trace of every pass
+PROFILE_DIR_ENV = "DEEQU_TPU_PROFILE_DIR"
+
 
 @dataclass
 class RunMonitor:
@@ -1525,9 +1533,9 @@ def resolve_scan_placement(scan_analyzers, placement, monitor=None) -> str:
       over the devices — streaming raw columns over a slow feed would
       starve ALL chips at once)
     """
-    import os
+    from ..utils import env_str
 
-    effective = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+    effective = placement or env_str(PLACEMENT_ENV, "auto")
     if not scan_analyzers:
         return "device"
     if not all(a.supports_host_partial for a in scan_analyzers):
@@ -1670,16 +1678,16 @@ _DEVICE_FEATURE_CACHE: Optional[_DeviceFeatureCache] = None
 
 
 def device_feature_cache() -> Optional[_DeviceFeatureCache]:
-    import os
+    from ..utils import env_number
 
     global _DEVICE_FEATURE_CACHE
     if getattr(_CACHE_BYPASS, "active", False):
         return None  # warm-run sample features must not enter the budget
-    env = os.environ.get(DEVICE_FEATURE_CACHE_ENV)
-    if not env or env == "0":
+    budget_gb = env_number(DEVICE_FEATURE_CACHE_ENV, 0.0, float, minimum=0.0)
+    if not budget_gb:
         return None
     if _DEVICE_FEATURE_CACHE is None:
-        _DEVICE_FEATURE_CACHE = _DeviceFeatureCache(int(float(env) * 1e9))
+        _DEVICE_FEATURE_CACHE = _DeviceFeatureCache(int(budget_gb * 1e9))
     return _DEVICE_FEATURE_CACHE
 
 
@@ -1824,7 +1832,7 @@ class ScanEngine:
         sharding: Optional[Any] = None,
         placement: Optional[str] = None,
     ):
-        import os
+        from ..utils import env_str
 
         self.scan_analyzers = list(scan_analyzers)
         self.monitor = monitor or RunMonitor()
@@ -1834,7 +1842,7 @@ class ScanEngine:
         #: failover re-pass (a NEW engine) is reporting into
         self._cancelled = _threading.Event()
         self.mesh = sharding  # a jax.sharding.Mesh -> row-sharded GSPMD scan
-        self.placement = placement or os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+        self.placement = placement or env_str(PLACEMENT_ENV, "auto")
         self.builder = FeatureBuilder(
             [s for a in self.scan_analyzers for s in a.feature_specs()]
         )
@@ -1912,9 +1920,10 @@ class ScanEngine:
         view with tensorboard or Perfetto). The lightweight phase timers in
         RunMonitor are always on."""
         import contextlib
-        import os
 
-        profile_dir = os.environ.get("DEEQU_TPU_PROFILE_DIR")
+        from ..utils import env_str
+
+        profile_dir = env_str(PROFILE_DIR_ENV)
         if profile_dir:
             import jax.profiler
 
@@ -2365,18 +2374,13 @@ class ScanEngine:
 
         from collections import deque
 
-        workers = 0
-        workers_env = os.environ.get(HOST_TIER_WORKERS_ENV)
-        if workers_env:
-            try:
-                workers = max(1, int(workers_env))
-            except ValueError:
-                # a typo'd sweep var must not crash every host-tier pass
-                # (which the resilience layer would then bisect N times)
-                _logger.warning(
-                    "ignoring invalid %s=%r; using the core-count default",
-                    HOST_TIER_WORKERS_ENV, workers_env,
-                )
+        from ..utils import env_number
+
+        # a typo'd sweep var must not crash every host-tier pass (which
+        # the resilience layer would then bisect N times): env_number
+        # warns once — including on negatives — and keeps the core-count
+        # default (0/unset = default)
+        workers = env_number(HOST_TIER_WORKERS_ENV, 0, int, minimum=0)
         workers = workers or max(2, os.cpu_count() or 1)
         window = workers + chunk  # in-flight bound: O(window) live batches
         pending: deque = deque()

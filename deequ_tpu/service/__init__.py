@@ -161,6 +161,9 @@ class VerificationService:
             "deequ_service_open_sessions", open_sessions,
             "Streaming sessions currently accepting micro-batches.",
         )
+        from .streaming import describe_streaming_series
+
+        describe_streaming_series(self.metrics)
 
     # -- one-shot jobs -------------------------------------------------------
 

@@ -76,9 +76,9 @@ FAST_PATH_MAX_ROWS_ENV = "DEEQU_TPU_FAST_PATH_MAX_ROWS"
 
 
 def coalesce_enabled() -> bool:
-    import os
+    from ..utils import env_flag
 
-    return os.environ.get(COALESCE_ENV, "1") != "0"
+    return env_flag(COALESCE_ENV, True)
 
 
 def coalesce_max_width() -> int:

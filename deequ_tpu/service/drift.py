@@ -46,7 +46,7 @@ growing a category set batch-over-batch is the normal streaming case.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from ..exceptions import SchemaDriftError
 

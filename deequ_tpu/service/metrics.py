@@ -76,6 +76,11 @@ class ServiceMetrics:
             "Gauge callables that raised during an exposition; the series "
             "was skipped so the rest of the scrape kept serving.",
         )
+        self.describe(
+            "deequ_service_phase_seconds_total",
+            "Engine phase wall-clock accumulated across runs, by phase "
+            "(straight from each job's RunMonitor.phase_seconds).",
+        )
 
     # -- registration / update ----------------------------------------------
 

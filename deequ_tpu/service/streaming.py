@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 _logger = logging.getLogger(__name__)
 
@@ -43,7 +43,54 @@ from ..analyzers.state_provider import (
 from ..checks import Check, CheckStatus
 from ..data import Dataset
 from .errors import SessionClosed
-from .scheduler import JobContext, JobHandle, Priority
+from .scheduler import JobContext, Priority
+
+
+def describe_streaming_series(metrics) -> None:
+    """Register HELP text for every export-plane series the streaming
+    sessions increment (called once per service). Literal per-series
+    ``describe`` calls, not a data-driven loop: the export-plane
+    completeness check in tools/statlint matches descriptions statically,
+    and an unrolled call per series is what it (and a grepping operator)
+    can see."""
+    metrics.describe(
+        "deequ_service_stream_batches_total",
+        "Micro-batches folded into streaming sessions' persisted states.",
+    )
+    metrics.describe(
+        "deequ_service_stream_rows_total",
+        "Rows folded into streaming sessions' persisted states.",
+    )
+    metrics.describe(
+        "deequ_service_stream_check_failures_total",
+        "Per-fold check evaluations that did not come back SUCCESS, by "
+        "status — the mid-stream anomaly signal.",
+    )
+    metrics.describe(
+        "deequ_service_drift_rejections_total",
+        "Micro-batches rejected BEFORE folding for incompatible schema "
+        "drift (typed SchemaDriftError; persisted states untouched).",
+    )
+    metrics.describe(
+        "deequ_service_drift_coercions_total",
+        "Columns coerced to the session contract's dtype on compatible "
+        "widenings (int32 arriving where int64 was promised).",
+    )
+    metrics.describe(
+        "deequ_service_drift_repairs_total",
+        "Micro-batches coerce-REPAIRED across hard schema drift per the "
+        "session's drift policy (the producer's schema changed).",
+    )
+    metrics.describe(
+        "deequ_service_drift_degraded_total",
+        "Micro-batches folded with drifted columns degraded to typed "
+        "Failure metrics per the session's drift policy.",
+    )
+    metrics.describe(
+        "deequ_service_callback_failures_total",
+        "on_result callbacks that raised; the fold had already committed, "
+        "so the failure is contained, logged and counted here.",
+    )
 
 
 def _bucket_batch_size(rows: int) -> int:

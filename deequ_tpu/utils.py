@@ -90,3 +90,27 @@ def env_number(env: str, default, cast, minimum=None):
             )
         return default
     return value
+
+
+def env_str(env: str, default=None):
+    """Shared reader for STRING-valued env knobs (paths, placement names,
+    JSON plans): no parsing to fall back from, but one choke point that
+    keeps every knob read on the ``utils.env_*`` surface the invariant
+    linter (tools/statlint, the env-knob-convention check) can see."""
+    import os
+
+    return os.environ.get(env, default)
+
+
+def env_flag(env: str, default: bool) -> bool:
+    """Shared reader for BOOLEAN env knobs following the repo's "0 means
+    off" convention: unset (or empty) keeps ``default``, the literal
+    ``"0"`` means False, anything else means True. Knobs with richer
+    semantics (tri-state probes, strict 0/1 validation with warn-once)
+    keep their own parsers and a statlint baseline entry."""
+    import os
+
+    raw = os.environ.get(env)
+    if raw is None or raw == "":
+        return default
+    return raw != "0"

@@ -385,11 +385,14 @@ class TestEnvKnobs:
     def test_heartbeat_warns_and_falls_back(self, monkeypatch, caplog):
         import logging
 
+        from deequ_tpu import utils
         from deequ_tpu.parallel import health
 
         monkeypatch.setenv(health.HEARTBEAT_ENV, "5s")
-        monkeypatch.setattr(health, "_ENV_WARNED", False)
-        with caplog.at_level(logging.WARNING, logger=health.__name__):
+        # the heartbeat knob now rides the SHARED utils.env_number parser
+        # (ISSUE 14's env-knob convention): reset its warn-once latch
+        monkeypatch.setattr(utils, "_ENV_WARNED", set())
+        with caplog.at_level(logging.WARNING, logger=utils.__name__):
             assert health.shard_heartbeat_s() == health.DEFAULT_HEARTBEAT_S
         assert any(
             "DEEQU_TPU_SHARD_HEARTBEAT_S" in r.message for r in caplog.records

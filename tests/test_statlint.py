@@ -1,0 +1,295 @@
+"""tools/statlint — the invariant linter that machine-checks the contracts
+the service plane is built on (ISSUE 14).
+
+Three layers of pinning:
+
+- the ZERO-FINDING GATE: the live tree must produce no non-baselined
+  findings (this is the tier-1 wire — a PR that violates a checked
+  contract fails here);
+- per-check POSITIVE fixtures: each seeded violation in
+  ``tools/statlint/fixtures`` must make the analyzer exit non-zero with
+  the expected check id — a check that cannot catch its own seeded
+  violation is not a check;
+- the baseline round trip: grandfathered findings suppress exactly
+  themselves, stale entries are reported, reason-less entries are
+  rejected.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.statlint import ModuleIndex, load_baseline, run_checks
+from tools.statlint.__main__ import main
+from tools.statlint.core import DEFAULT_BASELINE, REPO_ROOT
+
+pytestmark = pytest.mark.lint
+
+FIXTURES = os.path.join(REPO_ROOT, "tools", "statlint", "fixtures")
+PACKAGE = os.path.join(REPO_ROOT, "deequ_tpu")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# ---------------------------------------------------------------------------
+# the zero-finding gate (the tier-1 wire)
+# ---------------------------------------------------------------------------
+
+def test_zero_finding_gate_over_live_tree():
+    """`python -m tools.statlint` exits 0 on the tree: zero non-baselined
+    findings. Run in-process so tier-1 pays one parse pass, not a
+    subprocess interpreter start."""
+    rc = main([])
+    assert rc == 0
+
+
+def test_gate_runs_inside_timing_budget():
+    """The module-parse cache keeps the whole seven-check suite well under
+    the 30s budget ISSUE 14 allots it."""
+    import time
+
+    t0 = time.monotonic()
+    index = ModuleIndex([PACKAGE])
+    findings = run_checks(index)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30, f"statlint took {elapsed:.1f}s"
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new = [f for f in findings if f.fingerprint() not in baseline]
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_every_baseline_entry_still_fires():
+    """No stale suppressions: every baselined fingerprint corresponds to a
+    live finding (deleting the violation must force deleting the entry)."""
+    index = ModuleIndex([PACKAGE])
+    fired = {f.fingerprint() for f in run_checks(index)}
+    baseline = load_baseline(DEFAULT_BASELINE)
+    stale = sorted(set(baseline) - fired)
+    assert stale == [], stale
+
+
+# ---------------------------------------------------------------------------
+# per-check positive fixtures: the seeded violation must fire
+# ---------------------------------------------------------------------------
+
+FIXTURE_EXPECTATIONS = [
+    ("trace_purity_bad.py", "trace-purity", "wall-clock read"),
+    ("lock_discipline_bad.py", "lock-discipline", "commit-inversion shape"),
+    ("env_knobs_bad.py", "env-knob", "DEEQU_TPU_FIXTURE_KNOB"),
+    ("failure_registry_bad.py", "failure-registry", "RogueSubsystemError"),
+    ("export_help_bad.py", "export-help",
+     "deequ_service_fixture_undescribed_total"),
+    ("state_algebra_bad.py", "state-algebra", "no merge()"),
+    ("dead_imports_bad.py", "dead-import", "'json'"),
+]
+
+
+@pytest.mark.parametrize(
+    "fixture,check,needle", FIXTURE_EXPECTATIONS,
+    ids=[c for _, c, _ in FIXTURE_EXPECTATIONS],
+)
+def test_fixture_violation_fires(fixture, check, needle):
+    path = _fixture(fixture)
+    assert os.path.exists(path)
+    rc = main([path])
+    assert rc != 0, f"{fixture} should fail the gate"
+    index = ModuleIndex([path])
+    findings = [f for f in run_checks(index) if f.check == check]
+    assert findings, f"no {check} finding fired on {fixture}"
+    assert any(needle in f.message for f in findings), [
+        f.message for f in findings
+    ]
+
+
+def test_cli_module_entry_point():
+    """`python -m tools.statlint <fixture>` (the real CLI) exits non-zero —
+    one subprocess to pin the module wiring; everything else runs
+    in-process."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.statlint",
+         _fixture("lock_discipline_bad.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "lock-discipline" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fixture: the PR 13 known-bug shape
+# ---------------------------------------------------------------------------
+
+def test_lock_check_reproduces_pr13_bug_shape():
+    """The lock-discipline check must catch the PR 13 cross-key
+    commit-inversion pattern: a shared-field write reachable with and
+    without the owning lock — and name both paths."""
+    index = ModuleIndex([_fixture("lock_discipline_bad.py")])
+    findings = [
+        f for f in run_checks(index)
+        if f.check == "lock-discipline" and "unguarded-write" in f.key
+    ]
+    assert len(findings) == 1
+    message = findings[0].message
+    assert "commit" in message and "commit_unlocked" in message
+    assert "_committed" in message
+
+
+def test_lock_check_finds_acquisition_order_cycle():
+    index = ModuleIndex([_fixture("lock_discipline_bad.py")])
+    cycles = [
+        f for f in run_checks(index)
+        if f.check == "lock-discipline" and f.key.startswith("cycle:")
+    ]
+    assert len(cycles) == 1
+    assert "AccountA._lock" in cycles[0].message
+    assert "AccountB._lock" in cycles[0].message
+
+
+def test_locked_helper_convention_not_flagged(tmp_path):
+    """A `_foo_locked` helper whose every call site holds the lock —
+    including transitively through another helper — is guarded; only a
+    genuinely lockless path fires."""
+    module = tmp_path / "helper_convention.py"
+    module.write_text(
+        "import threading\n"
+        "class Fine:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state = 0\n"
+        "    def public(self):\n"
+        "        with self._lock:\n"
+        "            self._outer_locked()\n"
+        "    def _outer_locked(self):\n"
+        "        self._inner_locked()\n"
+        "    def _inner_locked(self):\n"
+        "        self._state += 1\n"
+    )
+    index = ModuleIndex([str(module)])
+    findings = [f for f in run_checks(index) if f.check == "lock-discipline"]
+    assert findings == [], [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# baseline round trip
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    fixture = _fixture("env_knobs_bad.py")
+    baseline = tmp_path / "baseline.json"
+    assert main(["--write-baseline", str(baseline), fixture]) == 0
+    payload = json.loads(baseline.read_text())
+    assert payload["entries"], "write-baseline must capture the finding"
+    # the written baseline suppresses exactly the captured findings
+    assert main(["--baseline", str(baseline), fixture]) == 0
+    # a clean module against the same baseline reports the entry as STALE
+    clean = tmp_path / "clean.py"
+    clean.write_text("VALUE = 1\n")
+    assert main(["--baseline", str(baseline), str(clean)]) == 1
+
+
+def test_unknown_check_id_is_an_error_not_a_silent_green(tmp_path):
+    """A typo'd --checks scope must exit 2, never run zero checks and
+    pass."""
+    rc = main(["--checks", "lock_discipline",  # underscore typo
+               _fixture("lock_discipline_bad.py")])
+    assert rc == 2
+    with pytest.raises(ValueError):
+        run_checks(ModuleIndex([_fixture("lock_discipline_bad.py")]),
+                   only=["nope"])
+
+
+def test_scoped_run_does_not_report_foreign_baseline_as_stale():
+    """--checks scoping must not flag unselected checks' baseline entries
+    as stale (obeying 'delete it' would break the full run)."""
+    rc = main(["--checks", "lock-discipline"])
+    assert rc == 0  # live tree is lock-clean; env-knob entries untouched
+
+
+def test_env_check_catches_bound_name_import_idiom(tmp_path):
+    """`from os import environ` / `from os import getenv` reads must not
+    evade the convention check."""
+    module = tmp_path / "evader.py"
+    module.write_text(
+        "from os import environ, getenv\n"
+        "A = environ.get('DEEQU_TPU_EVADED_A')\n"
+        "B = getenv('DEEQU_TPU_EVADED_B')\n"
+        "C = environ['DEEQU_TPU_EVADED_C']\n"
+    )
+    index = ModuleIndex([str(module)])
+    found = {
+        f.key for f in run_checks(index, only=["env-knob"])
+    }
+    assert found == {
+        "direct:DEEQU_TPU_EVADED_A",
+        "direct:DEEQU_TPU_EVADED_B",
+        "direct:DEEQU_TPU_EVADED_C",
+    }
+
+
+def test_trace_ring_clamps_to_floor(monkeypatch):
+    """DEEQU_TPU_TRACE_RING below the floor clamps to 16 (an operator
+    capping trace memory must not silently get the 4096 default)."""
+    # note: deequ_tpu.observability exports a FUNCTION named `recorder`
+    # that shadows the submodule attribute, so resolve via importlib
+    import importlib
+
+    recorder_mod = importlib.import_module("deequ_tpu.observability.recorder")
+
+    monkeypatch.setenv("DEEQU_TPU_TRACE_RING", "8")
+    assert recorder_mod.ring_capacity() == 16
+    monkeypatch.setenv("DEEQU_TPU_TRACE_RING", "64")
+    assert recorder_mod.ring_capacity() == 64
+
+
+def test_baseline_requires_reasons(tmp_path):
+    baseline = tmp_path / "noreason.json"
+    baseline.write_text(json.dumps(
+        {"entries": [{"fingerprint": "env-knob:x:y", "reason": "  "}]}
+    ))
+    with pytest.raises(ValueError):
+        load_baseline(str(baseline))
+    assert main(["--baseline", str(baseline), _fixture("env_knobs_bad.py")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# registry coherence pins (cheap spot checks on live invariants)
+# ---------------------------------------------------------------------------
+
+def test_fault_site_registry_matches_live_probes():
+    from deequ_tpu.reliability.faults import KNOWN_FAULT_SITES
+
+    assert "worker" in KNOWN_FAULT_SITES
+    assert "coalesced_fold" in KNOWN_FAULT_SITES  # the drift ISSUE 14 caught
+
+
+def test_subsystem_exceptions_import_lazily():
+    import deequ_tpu.exceptions as exc
+
+    assert exc.ExpressionError.__name__ == "ExpressionError"
+    assert exc.SerializationError.__name__ == "SerializationError"
+    assert exc.MeshExhaustedError.__name__ == "MeshExhaustedError"
+    assert exc.FrequencyBudgetExceeded.__name__ == "FrequencyBudgetExceeded"
+    with pytest.raises(AttributeError):
+        exc.NoSuchThing
+
+
+def test_env_helpers_follow_convention(monkeypatch):
+    from deequ_tpu.utils import env_flag, env_str
+
+    monkeypatch.delenv("DEEQU_TPU_TEST_FLAG", raising=False)
+    assert env_flag("DEEQU_TPU_TEST_FLAG", True) is True
+    monkeypatch.setenv("DEEQU_TPU_TEST_FLAG", "0")
+    assert env_flag("DEEQU_TPU_TEST_FLAG", True) is False
+    monkeypatch.setenv("DEEQU_TPU_TEST_FLAG", "1")
+    assert env_flag("DEEQU_TPU_TEST_FLAG", False) is True
+    monkeypatch.setenv("DEEQU_TPU_TEST_FLAG", "")
+    assert env_flag("DEEQU_TPU_TEST_FLAG", True) is True  # empty = unset
+    monkeypatch.setenv("DEEQU_TPU_TEST_STR", "s3://bucket")
+    assert env_str("DEEQU_TPU_TEST_STR") == "s3://bucket"
+    assert env_str("DEEQU_TPU_TEST_STR_MISSING", "dflt") == "dflt"

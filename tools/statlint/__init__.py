@@ -1,0 +1,14 @@
+"""Invariant linter: repo-specific static analysis for the contracts the
+service plane is built on. ``python -m tools.statlint`` gates tier-1 via
+``tests/test_statlint.py``; see ``core.py`` for the architecture and
+``checks/`` for one module per machine-checked contract."""
+
+from .core import (  # noqa: F401
+    Finding,
+    Module,
+    ModuleIndex,
+    apply_baseline,
+    load_baseline,
+    run_checks,
+    write_baseline,
+)

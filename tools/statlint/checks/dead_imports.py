@@ -1,0 +1,79 @@
+"""Check: dead imports.
+
+Unused imports in the concurrency-critical trees (``service/``,
+``parallel/``) are not just lint: they widen the import graph the lock
+and purity checks must reason about, and they rot into false "this module
+depends on X" signals for reviewers. Scope is deliberately narrow on the
+default tree (the ISSUE-14 bound); explicit file scans (fixtures) check
+everything they are given. ``# noqa`` on the import line and names listed
+in ``__all__`` are honored (the config-style re-export idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..core import Finding, Module, ModuleIndex
+
+CHECK = "dead-import"
+
+SCOPES = ("deequ_tpu/service/", "deequ_tpu/parallel/")
+
+
+def _used_names(module: Module) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # its base Name is walked separately
+        elif isinstance(node, ast.Assign):
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "__all__" in targets:
+                for const in ast.walk(node.value):
+                    if isinstance(const, ast.Constant) and isinstance(
+                        const.value, str
+                    ):
+                        used.add(const.value)
+    return used
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in index.modules:
+        if index.narrow and not any(s in module.relpath for s in SCOPES):
+            continue
+        used = _used_names(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                bindings = [
+                    (alias.asname or alias.name.split(".")[0], alias.name)
+                    for alias in node.names
+                ]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                bindings = [
+                    (alias.asname or alias.name, alias.name)
+                    for alias in node.names
+                    if alias.name != "*"
+                ]
+            else:
+                continue
+            if module.line_has_noqa(node):
+                continue
+            for bound, original in bindings:
+                if bound not in used:
+                    findings.append(Finding(
+                        check=CHECK, path=module.relpath, line=node.lineno,
+                        message=(
+                            f"imported name {bound!r} is never used "
+                            "(delete it, or `# noqa` a deliberate "
+                            "re-export)"
+                        ),
+                        key=f"{bound}",
+                    ))
+    return findings

@@ -1,0 +1,191 @@
+"""Check: the typed-failure and fault-site registries.
+
+The reliability story rests on two registries staying exhaustive:
+
+1. **Typed exceptions.** Every exception class defined in the package
+   must live in a registry module (``exceptions.py``, ``service/errors.py``,
+   ``runners/exceptions.py``, ``reliability/faults.py``) or be listed in
+   ``exceptions._SUBSYSTEM_EXCEPTIONS`` (the lazy re-export map) — a typed
+   failure nobody can import from the taxonomy is not typed. Stale
+   re-export entries (naming classes that moved/died) are flagged too.
+2. **Fault sites.** Every ``fault_point(site, ...)`` probe must name a
+   site in ``reliability/faults.KNOWN_FAULT_SITES``, and every registered
+   site must still have a live probe — the chaos tooling targets sites by
+   name, and a dangling name means a drill that silently exercises
+   nothing (the docstring-table drift this check replaces).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, ModuleIndex, literal_str
+
+CHECK = "failure-registry"
+
+REGISTRY_SUFFIXES = (
+    "deequ_tpu/exceptions.py",
+    "deequ_tpu/service/errors.py",
+    "deequ_tpu/runners/exceptions.py",
+    "deequ_tpu/reliability/faults.py",
+)
+
+_EXC_BASE_NAMES = {
+    "Exception", "BaseException", "RuntimeError", "ValueError",
+    "TypeError", "KeyError", "OSError", "KeyboardInterrupt",
+    "ImportError", "ArithmeticError", "StopIteration",
+}
+
+_EXC_NAME_SUFFIXES = (
+    "Error", "Exception", "Failure", "Interrupt", "Crash", "Exceeded",
+    "Overloaded", "Timeout", "Closed",
+)
+
+FAULT_SITES_NAME = "KNOWN_FAULT_SITES"
+REEXPORT_NAME = "_SUBSYSTEM_EXCEPTIONS"
+
+
+def _is_exception_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else None
+        )
+        if name is None:
+            continue
+        if name in _EXC_BASE_NAMES or name.endswith(_EXC_NAME_SUFFIXES):
+            return True
+    return False
+
+
+def _find_assign(module: Module, target: str) -> Optional[ast.AST]:
+    for node in module.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == target
+        ):
+            return node.value
+    return None
+
+
+def _fault_site_registry(index: ModuleIndex) -> Tuple[Optional[Set[str]], bool]:
+    """(registered sites, registry_in_scan). Fixture scans fall back to
+    the repo's live faults.py so unknown sites still resolve."""
+    module = index.get("deequ_tpu/reliability/faults.py")
+    in_scan = module is not None
+    if module is None:
+        module = index.side_load("deequ_tpu/reliability/faults.py")
+    if module is None:
+        return None, False
+    value = _find_assign(module, FAULT_SITES_NAME)
+    if value is None:
+        return None, in_scan
+    sites = {
+        node.value
+        for node in ast.walk(value)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    }
+    return sites, in_scan
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # -- half 1: exception classes must be registry-importable -------------
+    exceptions_mod = index.get("deequ_tpu/exceptions.py")
+    reexports: Dict[str, str] = {}
+    if exceptions_mod is not None:
+        value = _find_assign(exceptions_mod, REEXPORT_NAME)
+        if isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                key, val = literal_str(k), literal_str(v)
+                if key and val:
+                    reexports[key] = val
+    defined: Dict[str, str] = {}  # class -> module relpath
+    for module in index.modules:
+        in_registry = module.relpath.endswith(REGISTRY_SUFFIXES)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not _is_exception_class(node):
+                continue
+            defined[node.name] = module.relpath
+            if in_registry or node.name in reexports:
+                continue
+            findings.append(Finding(
+                check=CHECK, path=module.relpath, line=node.lineno,
+                message=(
+                    f"typed exception {node.name} is defined outside the "
+                    "registry modules and not re-exported via "
+                    f"exceptions.{REEXPORT_NAME}"
+                ),
+                key=f"exc-unregistered:{node.name}",
+            ))
+    if exceptions_mod is not None:
+        for name, dotted in sorted(reexports.items()):
+            relpath = dotted.replace(".", "/") + ".py"
+            target = index.get(relpath)
+            if target is None or not any(
+                isinstance(n, ast.ClassDef) and n.name == name
+                for n in ast.walk(target.tree)
+            ):
+                findings.append(Finding(
+                    check=CHECK, path=exceptions_mod.relpath, line=1,
+                    message=(
+                        f"{REEXPORT_NAME} entry {name} -> {dotted} names a "
+                        "class that does not exist there (stale registry)"
+                    ),
+                    key=f"exc-registry-stale:{name}",
+                ))
+
+    # -- half 2: fault_point sites <-> KNOWN_FAULT_SITES -------------------
+    sites, registry_in_scan = _fault_site_registry(index)
+    probed: Dict[str, Tuple[str, int]] = {}
+    for module in index.modules:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if name != "fault_point" or not node.args:
+                continue
+            site = literal_str(node.args[0])
+            if site is None:
+                # line-free key (core.py's fingerprint contract): the
+                # source expression itself is stable under edits above it
+                expr = ast.unparse(node.args[0])
+                findings.append(Finding(
+                    check=CHECK, path=module.relpath, line=node.lineno,
+                    message=(
+                        "fault_point site is not a string literal — the "
+                        "registry cannot vouch for dynamic site names"
+                    ),
+                    key=f"fault-site-dynamic:{expr}",
+                ))
+                continue
+            probed.setdefault(site, (module.relpath, node.lineno))
+            if sites is not None and site not in sites:
+                findings.append(Finding(
+                    check=CHECK, path=module.relpath, line=node.lineno,
+                    message=(
+                        f"fault_point site {site!r} is not registered in "
+                        f"reliability/faults.{FAULT_SITES_NAME}"
+                    ),
+                    key=f"fault-site-unregistered:{site}",
+                ))
+    if sites is not None and registry_in_scan:
+        for site in sorted(sites - set(probed)):
+            findings.append(Finding(
+                check=CHECK,
+                path="deequ_tpu/reliability/faults.py", line=1,
+                message=(
+                    f"{FAULT_SITES_NAME} lists {site!r} but no live "
+                    "fault_point probes it (dead registry entry)"
+                ),
+                key=f"fault-site-dead:{site}",
+            ))
+    return findings

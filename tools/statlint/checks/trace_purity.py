@@ -1,0 +1,264 @@
+"""Check: trace purity.
+
+Functions that run INSIDE a jax trace — anything registered with
+``jit``/``vmap``/``pjit``/``shard_map``/``lax.cond|scan|while_loop``, the
+flax state dataclasses' ``merge``/``update``/``compacted``/``append_keys``
+methods, and the analyzer ``update``/``from_host_partial`` fold bodies —
+must be pure: no wall clock, no host randomness, no env reads, no
+``.item()``/host materialization, no I/O. An impurity in a traced body is
+the worst kind of bug: it executes once at TRACE time, bakes a stale value
+into the compiled program, and then silently disagrees with every later
+dispatch (or re-triggers a compile per call).
+
+Reachability is a name-level over-approximation: calls resolve to
+same-module functions (any nesting), ``self.``/``cls.`` methods of the
+enclosing class, names imported from scanned modules, and — for the
+state-method names above — every flax-struct state class's method of that
+name. Over-approximation errs toward flagging; deliberate host-side
+helpers caught in the net carry baseline entries with reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Module, ModuleIndex, attr_chain
+
+CHECK = "trace-purity"
+
+#: APIs whose function-valued arguments execute inside a trace
+TRACE_APIS = {
+    "jit", "vmap", "pjit", "shard_map", "_shard_map", "pmap",
+    "cond", "scan", "while_loop", "fori_loop", "switch", "checkpoint",
+    "remat", "custom_vjp", "custom_jvp",
+}
+
+#: methods of flax.struct dataclasses (and analyzer fold protocols) that
+#: are traced by construction
+TRACED_METHOD_NAMES = {
+    "merge", "update", "compacted", "append_keys", "from_host_partial",
+}
+
+#: banned attribute-chain prefixes inside traced bodies
+_BANNED_PREFIXES = (
+    (("time",), "wall-clock read"),
+    (("np", "random"), "host randomness"),
+    (("numpy", "random"), "host randomness"),
+    (("random",), "host randomness"),
+    (("os", "environ"), "env read mid-trace"),
+    (("os", "getenv"), "env read mid-trace"),
+    (("jax", "device_get"), "host materialization"),
+)
+
+_BANNED_METHODS = {"item": "host materialization (.item())"}
+_BANNED_BUILTINS = {"open": "I/O", "print": "host I/O", "input": "host I/O"}
+
+
+def _is_flax_struct(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        chain = attr_chain(dec) or (
+            attr_chain(dec.func) if isinstance(dec, ast.Call) else None
+        )
+        if chain and chain[-1] == "dataclass" and any(
+            "struct" in part for part in chain
+        ):
+            return True
+    return False
+
+
+def _is_scan_shareable(cls: ast.ClassDef) -> bool:
+    """Classes whose fold methods ride the fused device program. Host-side
+    accumulators (GroupingAnalyzer's pandas group-bys) also define
+    ``update``/``merge`` but never enter a trace — only the ScanShareable
+    hierarchy and flax state dataclasses do."""
+    for base in cls.bases:
+        node = base.value if isinstance(base, ast.Subscript) else base
+        chain = attr_chain(node)
+        if chain and "ScanShareable" in chain[-1]:
+            return True
+    return False
+
+
+class _FuncInfo:
+    __slots__ = ("module", "node", "qualname", "cls")
+
+    def __init__(self, module: Module, node: ast.AST, qualname: str,
+                 cls: Optional[ast.ClassDef]):
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls
+
+
+def _index_functions(index: ModuleIndex):
+    """Tables: per-module name->funcs (any nesting), per-class methods,
+    flax state classes, import links between scanned modules."""
+    by_module: Dict[str, Dict[str, List[_FuncInfo]]] = {}
+    methods: Dict[Tuple[str, str], Dict[str, _FuncInfo]] = {}
+    state_methods: Dict[str, List[_FuncInfo]] = {}
+
+    for module in index.modules:
+        table: Dict[str, List[_FuncInfo]] = {}
+        by_module[module.relpath] = table
+
+        def visit(node, cls, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = _FuncInfo(
+                        module, child, f"{prefix}{child.name}", cls
+                    )
+                    table.setdefault(child.name, []).append(info)
+                    if cls is not None:
+                        methods.setdefault(
+                            (module.relpath, cls.name), {}
+                        )[child.name] = info
+                        if child.name in TRACED_METHOD_NAMES and (
+                            _is_flax_struct(cls) or _is_scan_shareable(cls)
+                        ):
+                            state_methods.setdefault(
+                                child.name, []
+                            ).append(info)
+                    visit(child, cls, f"{prefix}{child.name}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, cls, prefix)
+
+        visit(module.tree, None, "")
+    return by_module, methods, state_methods
+
+
+def _roots(index: ModuleIndex, by_module, methods, state_methods):
+    roots: List[_FuncInfo] = []
+    # 1. every traced state/analyzer fold method
+    for infos in state_methods.values():
+        roots.extend(infos)
+    # 2. functions registered with a tracing API (call args or decorators)
+    for module in index.modules:
+        table = by_module[module.relpath]
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] not in TRACE_APIS:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in table:
+                        roots.extend(table[arg.id])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    chain = attr_chain(target)
+                    if chain and chain[-1] in TRACE_APIS:
+                        roots.extend(table.get(node.name, []))
+    return roots
+
+
+def _called_infos(info: _FuncInfo, by_module, methods, state_methods, index):
+    """Resolve the call sites inside one function body."""
+    out: List[_FuncInfo] = []
+    module = info.module
+    table = by_module[module.relpath]
+    imports: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.level:
+            # relative import within the package: resolve to a relpath
+            base = module.relpath.rsplit("/", 1)[0]
+            for _ in range(node.level - 1):
+                base = base.rsplit("/", 1)[0]
+            dotted = (node.module or "").replace(".", "/")
+            target = f"{base}/{dotted}".rstrip("/")
+            for alias in node.names:
+                imports[alias.asname or alias.name] = (target, alias.name)
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in table:
+                out.extend(table[func.id])
+            elif func.id in imports:
+                target_rel, original = imports[func.id]
+                target = index.get(f"{target_rel}.py") or index.get(
+                    f"{target_rel}/__init__.py"
+                )
+                if target is not None:
+                    out.extend(
+                        by_module[target.relpath].get(original, [])
+                    )
+        elif isinstance(func, ast.Attribute):
+            chain = attr_chain(func)
+            if chain and chain[0] in ("self", "cls") and len(chain) == 2:
+                if info.cls is not None:
+                    m = methods.get(
+                        (module.relpath, info.cls.name), {}
+                    ).get(chain[1])
+                    if m is not None:
+                        out.append(m)
+            if func.attr in state_methods and not (
+                chain and chain[0] in ("jnp", "np", "jax", "lax")
+            ):
+                # state-method dispatch: a.merge(b) on an unknown receiver
+                out.extend(state_methods[func.attr])
+    return out
+
+
+def _impurities(info: _FuncInfo) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        chain = attr_chain(func)
+        if chain:
+            for prefix, why in _BANNED_PREFIXES:
+                exact_call = prefix in (("os", "getenv"), ("jax", "device_get"))
+                if tuple(chain[: len(prefix)]) == prefix and (
+                    len(chain) > len(prefix) or exact_call
+                ):
+                    out.append((node.lineno, f"{'.'.join(chain)} ({why})"))
+                    break
+        if isinstance(func, ast.Attribute) and func.attr in _BANNED_METHODS:
+            out.append(
+                (node.lineno, _BANNED_METHODS[func.attr])
+            )
+        if isinstance(func, ast.Name) and func.id in _BANNED_BUILTINS:
+            out.append(
+                (node.lineno, f"{func.id}() ({_BANNED_BUILTINS[func.id]})")
+            )
+    return out
+
+
+def run(index: ModuleIndex) -> List[Finding]:
+    by_module, methods, state_methods = _index_functions(index)
+    roots = _roots(index, by_module, methods, state_methods)
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    visited: Set[int] = set()
+    stack: List[Tuple[_FuncInfo, str]] = [
+        (r, f"{r.module.relpath}:{r.qualname}") for r in roots
+    ]
+    while stack:
+        info, origin = stack.pop()
+        if id(info.node) in visited:
+            continue
+        visited.add(id(info.node))
+        for line, what in _impurities(info):
+            ident = (f"{info.module.relpath}:{info.qualname}", what)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            findings.append(Finding(
+                check=CHECK, path=info.module.relpath, line=line,
+                message=(
+                    f"{info.qualname} is reachable from traced code "
+                    f"(root: {origin}) but calls {what}"
+                ),
+                key=f"{info.qualname}:{what}",
+            ))
+        for callee in _called_infos(
+            info, by_module, methods, state_methods, index
+        ):
+            stack.append((callee, origin))
+    return findings

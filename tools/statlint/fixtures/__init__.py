@@ -1,0 +1,5 @@
+"""Seeded-violation fixtures: each module plants exactly the contract
+violation its namesake check exists to catch. They are PARSED by the
+linter (never imported/executed) and pinned by tests/test_statlint.py:
+``python -m tools.statlint <fixture>`` must exit non-zero, one per check —
+a check that cannot catch its own seeded violation is not a check."""

@@ -1,0 +1,23 @@
+"""Seeded violation for the trace-purity check: a jit-registered function
+reads the wall clock, which would bake ONE trace-time timestamp into the
+compiled program forever."""
+
+import time
+
+import jax
+
+
+def impure_update(state, xs):
+    stamp = time.time()  # trace-time read, baked into the program
+    return state + xs.sum() + stamp
+
+
+def chained_helper(state):
+    return state.item()  # host materialization inside a trace
+
+
+def traced_entry(state, xs):
+    return chained_helper(impure_update(state, xs))
+
+
+program = jax.jit(traced_entry)
