@@ -1149,6 +1149,54 @@ def run_streaming_knee_stage() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# stage 2e: anomaly fleet (ISSUE 15 acceptance) — the fleet watch's
+# per-harvest scoring core: 10k tenants' metric histories, serial vs ONE
+# batched detect_batch call, parity-gated
+# ---------------------------------------------------------------------------
+
+
+def run_anomaly_fleet_stage(n_series: int = 10_000) -> dict:
+    """Series/s for the fleet-watch scoring pass (tools/
+    anomaly_fleet_bench.py): N ragged series with newest-point intervals,
+    scored serially (one detect per series) and batched (ONE detect_batch
+    over the fleet tensor), flag indices and messages element-identical.
+    Runs DETACHED so the child's numpy working set starts cold."""
+    import json as _json
+    import os
+    import subprocess
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.anomaly_fleet_bench",
+            "--series", str(n_series),
+        ],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=subprocess_timeout_s(),
+    )
+    if not proc.stdout.strip():
+        raise RuntimeError(
+            f"anomaly_fleet subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}"
+        )
+    result = _json.loads(proc.stdout.strip().splitlines()[-1])
+    result["stage_seconds"] = time.perf_counter() - t0
+    if not result["parity"]:
+        log("PARITY MISMATCH anomaly fleet: batched != serial scoring")
+        sys.exit(1)
+    log(
+        f"[anomaly_fleet] {result['series']:,} series "
+        f"({result['points_total']:,} points): batched "
+        f"{result['series_per_s']:,.0f} series/s in "
+        f"{result['detect_calls']} call vs serial "
+        f"{result['serial_series_per_s']:,.0f}/s "
+        f"({result['speedup']:.1f}x), {result['flagged']} flagged, "
+        f"parity element-exact"
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
 # stage 3: incremental/stateful partitions + sketch-state merge (BASELINE
 # config 4: partition states persisted, table metrics refreshed from merged
 # states WITHOUT rescanning data, anomaly check on the history)
@@ -1827,6 +1875,25 @@ def main() -> None:
                 for p in knee["points"]
             ],
             "parity_bit_exact": knee["parity"]["bit_exact"],
+        })
+
+    anomaly_fleet = staged(
+        "anomaly_fleet", run_anomaly_fleet_stage,
+        # detached child with its own process startup: give it the
+        # subprocess budget, not one in-process stage's
+        budget_s=subprocess_timeout_s() + 30,
+    )
+    if anomaly_fleet is not None:
+        out["anomaly_fleet_series_per_s"] = anomaly_fleet["series_per_s"]
+        out["anomaly_fleet_serial_series_per_s"] = anomaly_fleet[
+            "serial_series_per_s"
+        ]
+        out["anomaly_fleet_speedup"] = anomaly_fleet["speedup"]
+        out["anomaly_fleet_flagged"] = anomaly_fleet["flagged"]
+        checkpoint("anomaly_fleet", extra={
+            "series": anomaly_fleet["series"],
+            "detect_calls": anomaly_fleet["detect_calls"],
+            "parity": anomaly_fleet["parity"],
         })
 
     mesh_scaling = staged(

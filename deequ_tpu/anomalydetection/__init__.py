@@ -51,6 +51,27 @@ class AnomalyDetectionStrategy(abc.ABC):
     ) -> List[Tuple[int, Anomaly]]:
         """Find anomalies at indices within [start, end) of the series."""
 
+    def detect_batch(self, series_list, search_interval):
+        """Score N series at once; returns one ``[(index, Anomaly), ...]``
+        list per series. ``search_interval``: one shared ``(start, end)``
+        tuple, or a sequence of N per-series tuples (the fleet-watch
+        shape). This default simply loops :meth:`detect` — every strategy
+        is batchable by contract; the vectorizable strategies override it
+        with array-shaped cores that are element-for-element identical
+        to serial (parity-pinned by tests/test_anomaly_reference.py)."""
+        from .strategies import normalize_intervals
+
+        if not len(series_list):
+            return []
+        starts, ends = normalize_intervals(
+            len(series_list), search_interval,
+            "The start of the interval can't be larger than the end.",
+        )
+        return [
+            self.detect(series, (int(starts[i]), int(ends[i])))
+            for i, series in enumerate(series_list)
+        ]
+
 
 @dataclass(frozen=True)
 class AnomalyDetector:

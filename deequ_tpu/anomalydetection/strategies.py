@@ -17,6 +17,42 @@ _NEG_INF = -sys.float_info.max
 _POS_INF = sys.float_info.max
 
 
+def normalize_intervals(n_series: int, search_interval, message: str):
+    """Per-series (starts, ends) int64 arrays from either ONE shared
+    ``(start, end)`` tuple or a sequence of N per-series tuples — the
+    fleet-watch shape, where every tenant's "newest point" sits at its own
+    ragged index. Validates each interval with the caller's exact serial
+    error ``message`` so batched and serial paths fail identically."""
+    seq = list(search_interval)
+    if len(seq) == 2 and not hasattr(seq[0], "__len__"):
+        starts = np.full(n_series, int(seq[0]), dtype=np.int64)
+        ends = np.full(n_series, int(seq[1]), dtype=np.int64)
+    else:
+        if len(seq) != n_series:
+            raise ValueError(
+                f"need one search interval or one per series "
+                f"({n_series}), got {len(seq)}"
+            )
+        starts = np.array([int(s) for s, _ in seq], dtype=np.int64)
+        ends = np.array([int(e) for _, e in seq], dtype=np.int64)
+    if np.any(starts > ends):
+        raise ValueError(message)
+    return starts, ends
+
+
+def pad_series_matrix(series_list):
+    """Right-pad N ragged series into a float64 ``[N, T]`` matrix plus the
+    per-series lengths (the mask). Padding is zeros; every batched core
+    masks it out via the lengths."""
+    arrays = [np.asarray(s, dtype=np.float64) for s in series_list]
+    lengths = np.array([len(a) for a in arrays], dtype=np.int64)
+    t = int(lengths.max()) if len(arrays) else 0
+    m = np.zeros((len(arrays), t))
+    for i, a in enumerate(arrays):
+        m[i, : len(a)] = a
+    return m, lengths
+
+
 @dataclass(frozen=True)
 class SimpleThresholdStrategy(AnomalyDetectionStrategy):
     """Flags values outside [lower_bound, upper_bound]
@@ -50,6 +86,42 @@ class SimpleThresholdStrategy(AnomalyDetectionStrategy):
                 )
         return out
 
+    def detect_batch(self, series_list, search_interval):
+        """Batched :meth:`detect`: N ragged series flag through ONE
+        vectorized bounds compare (``search_interval``: one shared tuple
+        or one per series) — element-for-element identical to serial."""
+        if not len(series_list):
+            return []
+        starts, ends = normalize_intervals(
+            len(series_list), search_interval,
+            "The start of the interval can't be larger than the end.",
+        )
+        m, lengths = pad_series_matrix(series_list)
+        idx = np.arange(m.shape[1], dtype=np.int64)
+        in_window = (
+            (idx[None, :] >= starts[:, None])
+            & (idx[None, :] < np.minimum(ends, lengths)[:, None])
+        )
+        flags = in_window & ((m < self.lower_bound) | (m > self.upper_bound))
+        out = []
+        for i, series in enumerate(series_list):
+            rows = []
+            for index in np.nonzero(flags[i])[0]:
+                value = series[int(index)]
+                rows.append(
+                    (
+                        int(index),
+                        Anomaly(
+                            value,
+                            1.0,
+                            f"[SimpleThresholdStrategy]: Value {value} is not in bounds "
+                            f"[{self.lower_bound}, {self.upper_bound}]",
+                        ),
+                    )
+                )
+            out.append(rows)
+        return out
+
 
 @dataclass(frozen=True)
 class _BaseChangeStrategy(AnomalyDetectionStrategy):
@@ -79,6 +151,63 @@ class _BaseChangeStrategy(AnomalyDetectionStrategy):
         if order == 0 or len(series) == 0:
             return series
         return self.diff(series[1:] - series[:-1], order - 1)
+
+    def diff_matrix(self, m: np.ndarray, order: int) -> np.ndarray:
+        """The series-axis twin of :meth:`diff` over an ``[N, T]`` matrix
+        (same recursive pairwise subtraction, columns instead of scalars).
+        Each output column j holds the order-``order`` change ending at
+        input column ``j + order`` — window-start independent, which is
+        what lets ONE matrix diff serve every per-series interval."""
+        if order == 0 or m.shape[1] == 0:
+            return m
+        return self.diff_matrix(m[:, 1:] - m[:, :-1], order - 1)
+
+    def detect_batch(self, series_list, search_interval):
+        """Batched :meth:`detect`: N ragged series' nth-order changes
+        compute in ONE matrix diff (``search_interval``: one shared tuple
+        or one per series) — element-for-element identical to serial,
+        because ``diff`` of a window equals the full-series diff
+        restricted to the window's columns."""
+        if not len(series_list):
+            return []
+        starts, ends = normalize_intervals(
+            len(series_list), search_interval,
+            "The start of the interval cannot be larger than the end.",
+        )
+        m, lengths = pad_series_matrix(series_list)
+        lo = self.max_rate_decrease if self.max_rate_decrease is not None else _NEG_INF
+        hi = self.max_rate_increase if self.max_rate_increase is not None else _POS_INF
+        changes = self.diff_matrix(m, self.order)
+        # diff column j = change ending at index j + order; the serial
+        # window [max(start-order,0) : min(end,len)] maps to diff columns
+        # [max(start-order,0), min(end,len)-order)
+        j = np.arange(changes.shape[1], dtype=np.int64)
+        start_points = np.maximum(starts - self.order, 0)
+        stop = np.minimum(ends, lengths) - self.order
+        in_window = (
+            (j[None, :] >= start_points[:, None])
+            & (j[None, :] < stop[:, None])
+        )
+        flags = in_window & ((changes < lo) | (changes > hi))
+        out = []
+        for i, series in enumerate(series_list):
+            rows = []
+            for col in np.nonzero(flags[i])[0]:
+                index = int(col) + self.order
+                change = changes[i, int(col)]
+                rows.append(
+                    (
+                        index,
+                        Anomaly(
+                            series[index],
+                            1.0,
+                            f"[AbsoluteChangeStrategy]: Change of {change} is not in bounds "
+                            f"[{lo}, {hi}]. Order={self.order}",
+                        ),
+                    )
+                )
+            out.append(rows)
+        return out
 
     def detect(self, data_series, search_interval):
         start, end = search_interval
@@ -130,6 +259,14 @@ class RelativeRateOfChangeStrategy(_BaseChangeStrategy):
             return series
         with np.errstate(divide="ignore", invalid="ignore"):
             return series[order:] / series[:-order]
+
+    def diff_matrix(self, m: np.ndarray, order: int) -> np.ndarray:
+        if order <= 0:
+            raise ValueError("Order of diff cannot be zero or negative")
+        if m.shape[1] == 0:
+            return m
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return m[:, order:] / m[:, :-order]
 
 
 @dataclass(frozen=True)
@@ -233,9 +370,11 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
         same order, same IEEE ops), pinned by parity tests.
 
         ``series_matrix``: float64 ``[N, T]``, ragged series padded on the
-        right (padding is ignored via ``lengths``). Returns ``(means,
-        std_devs, is_anomaly)`` each ``[N, T]``; entries past a series'
-        length are zeros/False."""
+        right (padding is ignored via ``lengths``). ``search_interval``:
+        one shared ``(start, end)`` tuple, or a sequence of N per-series
+        tuples (the fleet-watch shape — each tenant's newest point sits at
+        its own ragged index). Returns ``(means, std_devs, is_anomaly)``
+        each ``[N, T]``; entries past a series' length are zeros/False."""
         m = np.asarray(series_matrix, dtype=np.float64)
         if m.ndim != 2:
             raise ValueError("series_matrix must be [n_series, n_points]")
@@ -252,7 +391,15 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
             self.lower_deviation_factor
             if self.lower_deviation_factor is not None else _POS_INF
         )
-        search_start, search_end = search_interval
+        seq = list(search_interval)
+        if len(seq) == 2 and not hasattr(seq[0], "__len__"):
+            search_start, search_end = int(seq[0]), int(seq[1])
+        else:
+            # per-series intervals: the comparisons below are elementwise,
+            # so arrays slot straight in (no validation here — the scalar
+            # compute_stats_and_anomalies performs none either)
+            search_start = np.array([int(s) for s, _ in seq], dtype=np.int64)
+            search_end = np.array([int(e) for _, e in seq], dtype=np.int64)
         num_skip = lengths * self.ignore_start_percentage
         means = np.zeros((n, t))
         std_devs = np.zeros((n, t))
@@ -296,22 +443,20 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
 
     def detect_batch(self, series_list, search_interval):
         """Batched :meth:`detect`: N series score through ONE
-        ``compute_stats_batch`` call; returns a list over series of the
-        same ``[(index, Anomaly), ...]`` the one-series path produces
-        (bounds, messages and indices identical — parity-pinned)."""
-        start, end = search_interval
-        if start > end:
-            raise ValueError("The start of the interval can't be larger than the end.")
-        series_list = [np.asarray(s, dtype=np.float64) for s in series_list]
-        if not series_list:
+        ``compute_stats_batch`` call (``search_interval``: one shared
+        tuple or one per series); returns a list over series of the same
+        ``[(index, Anomaly), ...]`` the one-series path produces (bounds,
+        messages and indices identical — parity-pinned)."""
+        if not len(series_list):
             return []
-        lengths = np.array([len(s) for s in series_list], dtype=np.int64)
-        t = int(lengths.max()) if len(lengths) else 0
-        m = np.zeros((len(series_list), t))
-        for i, s in enumerate(series_list):
-            m[i, : len(s)] = s
+        starts, ends = normalize_intervals(
+            len(series_list), search_interval,
+            "The start of the interval can't be larger than the end.",
+        )
+        series_list = [np.asarray(s, dtype=np.float64) for s in series_list]
+        m, lengths = pad_series_matrix(series_list)
         means, std_devs, flags = self.compute_stats_batch(
-            m, lengths, search_interval
+            m, lengths, list(zip(starts.tolist(), ends.tolist()))
         )
         upper_factor = (
             self.upper_deviation_factor
@@ -324,7 +469,7 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
         out = []
         for i, series in enumerate(series_list):
             rows = []
-            for index in range(start, min(end, len(series))):
+            for index in range(int(starts[i]), min(int(ends[i]), len(series))):
                 if not flags[i, index]:
                     continue
                 mean = means[i, index]
@@ -405,4 +550,72 @@ class BatchNormalStrategy(AnomalyDetectionStrategy):
                         ),
                     )
                 )
+        return out
+
+    def detect_batch(self, series_list, search_interval):
+        """Batched :meth:`detect` over N ragged series (``search_interval``:
+        one shared tuple or one per series). The per-series mean/stdDev
+        reductions run on each row's exact basis slice (identical
+        reduction order — a masked full-width sum would round differently
+        under numpy's pairwise summation); the bounds compare is one
+        vectorized pass."""
+        if not len(series_list):
+            return []
+        starts, ends = normalize_intervals(
+            len(series_list), search_interval,
+            "The start of the interval can't be larger than the end.",
+        )
+        upper_factor = (
+            self.upper_deviation_factor if self.upper_deviation_factor is not None else _POS_INF
+        )
+        lower_factor = (
+            self.lower_deviation_factor if self.lower_deviation_factor is not None else _POS_INF
+        )
+        m, lengths = pad_series_matrix(series_list)
+        n = len(series_list)
+        uppers = np.zeros(n)
+        lowers = np.zeros(n)
+        for i in range(n):
+            if lengths[i] == 0:
+                raise ValueError("Data series is empty. Can't calculate mean/ stdDev.")
+            series = m[i, : lengths[i]]
+            end_capped = min(int(ends[i]), int(lengths[i]))
+            if self.include_interval:
+                basis = series
+            else:
+                basis = np.concatenate(
+                    [series[: int(starts[i])], series[end_capped:]]
+                )
+                if len(basis) == 0:
+                    raise ValueError(
+                        "Excluding values in searchInterval from calculation but not enough values "
+                        "remain to calculate mean and stdDev."
+                    )
+            mean = float(np.mean(basis))
+            std_dev = float(np.std(basis, ddof=1)) if len(basis) > 1 else 0.0
+            uppers[i] = mean + upper_factor * std_dev
+            lowers[i] = mean - lower_factor * std_dev
+        idx = np.arange(m.shape[1], dtype=np.int64)
+        in_window = (
+            (idx[None, :] >= starts[:, None])
+            & (idx[None, :] < np.minimum(ends, lengths)[:, None])
+        )
+        flags = in_window & ((m > uppers[:, None]) | (m < lowers[:, None]))
+        out = []
+        for i in range(n):
+            rows = []
+            for index in np.nonzero(flags[i])[0]:
+                value = m[i, int(index)]
+                rows.append(
+                    (
+                        int(index),
+                        Anomaly(
+                            float(value),
+                            1.0,
+                            f"[BatchNormalStrategy]: Value {value} is not in "
+                            f"bounds [{lowers[i]}, {uppers[i]}].",
+                        ),
+                    )
+                )
+            out.append(rows)
         return out

@@ -184,6 +184,25 @@ def slim_fetch_enabled() -> bool:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# Fleet watch — the standing fleet-scale anomaly plane (implemented in
+# deequ_tpu.service.fleetwatch; the env knobs are documented here with the
+# other operator-facing switches and re-exported below). All three follow
+# the warn-and-fallback convention via the shared utils parsers.
+#
+# - DEEQU_TPU_FLEETWATCH: "0" detaches the standing watch from scheduler
+#   harvests (explicit FleetWatch.harvest_now() still scores); default on.
+#   When attached, every completed job of a WATCHED tenant triggers one
+#   debounced scoring pass over every watched tenant's metric history.
+# - DEEQU_TPU_FLEETWATCH_WINDOW_MONTHS: metric-history window each
+#   harvest scores, in month buckets (default 12; 0 = unbounded). Rides
+#   the PartitionedMetricsRepository's O(queried window) loads, so a year
+#   of per-run history never costs a full-history deserialize per score.
+# - DEEQU_TPU_FLEETWATCH_BUNDLE: maximum series stacked into one batched
+#   detect_batch call (default 16384 — a 10k-tenant fleet scores in ONE
+#   call per strategy bundle; larger fleets chunk).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Engine placement / host tier / profiling (implemented in
 # deequ_tpu.runners.engine + .analysis_runner; documented here with the
 # other operator-facing switches — the invariant linter's env-knob check
@@ -295,6 +314,11 @@ from .service.fleet import (  # noqa: E402,F401
 from .repository.partition_store import (  # noqa: E402,F401
     PARTITION_STORE_ENV,
     PARTITION_WINDOW_ENV,
+)
+from .service.fleetwatch import (  # noqa: E402,F401
+    FLEETWATCH_BUNDLE_ENV,
+    FLEETWATCH_ENV,
+    FLEETWATCH_WINDOW_ENV,
 )
 from .observability.recorder import FLIGHT_DIR_ENV  # noqa: E402,F401
 from .parallel.elastic import MESH_LADDER_ENV  # noqa: E402,F401

@@ -214,6 +214,43 @@ def list_dirs(path: str) -> list:
     )
 
 
+def list_files(path: str) -> list:
+    """Immediate child FILE names of ``path``, sorted; [] when the path
+    does not exist. Other failures (auth, network) RAISE — the same
+    contract as :func:`list_dirs` (a store misconfiguration must not read
+    as an empty listing)."""
+    if is_local(path):
+        local = _strip_file_scheme(path)
+        if not os.path.isdir(local):
+            return []
+        return sorted(
+            e for e in os.listdir(local)
+            if os.path.isfile(os.path.join(local, e))
+        )
+    adapter = _resolve_remote(path)
+    fs = adapter.fs
+    if hasattr(fs, "ls"):  # fsspec
+        try:
+            entries = fs.ls(adapter.path, detail=True)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            os.path.basename(str(e["name"]).rstrip("/"))
+            for e in entries
+            if e.get("type") == "file"
+        )
+    import pyarrow.fs as pafs
+
+    infos = fs.get_file_info(
+        pafs.FileSelector(adapter.path, allow_not_found=True)
+    )
+    return sorted(
+        os.path.basename(i.path)
+        for i in infos
+        if i.type == pafs.FileType.File
+    )
+
+
 def write_text_atomic(path: str, payload: str) -> None:
     """Local: write-to-temp + rename so a crash mid-write never corrupts the
     target (the reference relies on HDFS create-overwrite the same way).

@@ -145,6 +145,10 @@ class MetricsRepositoryMultipleResultsLoader(abc.ABC):
 
 from .memory import InMemoryMetricsRepository  # noqa: E402
 from .fs import FileSystemMetricsRepository  # noqa: E402
+from .partitioned import (  # noqa: E402
+    PartitionedMetricsRepository,
+    month_bucket,
+)
 from .partition_store import (  # noqa: E402
     PartitionManifest,
     PartitionStateStore,
@@ -160,7 +164,9 @@ __all__ = [
     "MetricsRepositoryMultipleResultsLoader",
     "PartitionManifest",
     "PartitionStateStore",
+    "PartitionedMetricsRepository",
     "ResultKey",
     "default_partition_store",
+    "month_bucket",
     "partition_bucket",
 ]

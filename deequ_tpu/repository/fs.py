@@ -60,6 +60,16 @@ class FileSystemMetricsRepository(MetricsRepository):
     def __init__(self, path: str, monitor: Optional[Any] = None):
         self.path = path
         self.monitor = monitor
+        #: entries fully deserialized (checksum-verified + metric map
+        #: materialized) by this repository's reads — the windowed-load
+        #: regression pin: a bounded query must never deserialize entries
+        #: outside its [after, before] window, even on this legacy
+        #: one-file layout
+        self.entries_deserialized = 0
+        #: quarantines THIS repository performed (per-instance corruption
+        #: attribution — the fleet watch reads this, never the
+        #: process-global counter)
+        self.quarantines = 0
 
     def save(self, result_key: ResultKey, analyzer_context: AnalyzerContext) -> None:
         successful = AnalyzerContext(
@@ -73,7 +83,9 @@ class FileSystemMetricsRepository(MetricsRepository):
         # file (the quarantine sidecar preserves its bytes) and retries.
         existing = [
             r
-            for r in self._read_all(raise_on_torn_file=True)
+            # count=False: entries_deserialized is the READ-path windowed
+            # pin; the rewrite's own full read must not pollute it
+            for r in self._read_all(raise_on_torn_file=True, count=False)
             if r.result_key != result_key
         ]
         existing.append(AnalysisResult(result_key, successful))
@@ -113,6 +125,7 @@ class FileSystemMetricsRepository(MetricsRepository):
         except Exception:  # noqa: BLE001 - best-effort preservation
             where = "<unwritable quarantine dir>"
         _count_quarantine()
+        self.quarantines += 1
         if self.monitor is not None:
             try:
                 self.monitor.bump("corrupt_quarantined")
@@ -130,8 +143,21 @@ class FileSystemMetricsRepository(MetricsRepository):
         )
 
     def _read_all(
-        self, raise_on_torn_file: bool = False
+        self,
+        raise_on_torn_file: bool = False,
+        after: Optional[int] = None,
+        before: Optional[int] = None,
+        count: bool = True,
     ) -> List[AnalysisResult]:
+        """All entries — or, with ``after``/``before`` bounds, only the
+        entries inside the window. Even on this one-file layout a bounded
+        query must not pay O(all history) deserialization: the structural
+        JSON parse is unavoidable (one file), but each entry's result-key
+        date is PEEKED from the raw dict first and out-of-window entries
+        are skipped before their checksums verify or their metric maps
+        materialize (``entries_deserialized`` pins it). An entry whose key
+        cannot even be peeked still deserializes, so the quarantine path
+        sees it."""
         from ..reliability.faults import fault_point
 
         if not dio.exists(self.path):
@@ -159,7 +185,11 @@ class FileSystemMetricsRepository(MetricsRepository):
             return []
         results: List[AnalysisResult] = []
         for entry in entries:
+            if entry_outside_window(entry, after, before):
+                continue
             try:
+                if count:
+                    self.entries_deserialized += 1
                 results.append(deserialize_result(entry, source=self.path))
             except CorruptStateError as exc:
                 self._quarantine(
@@ -168,10 +198,33 @@ class FileSystemMetricsRepository(MetricsRepository):
         return results
 
 
+def entry_outside_window(
+    entry: Any, after: Optional[int], before: Optional[int]
+) -> bool:
+    """Whether a RAW serialized entry's result-key date provably falls
+    outside [after, before] (both inclusive, matching the loader's
+    filter). Unpeekable entries answer False so they still flow through
+    full deserialization — and its quarantine path."""
+    if after is None and before is None:
+        return False
+    try:
+        date = int(entry["resultKey"]["dataSetDate"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    if after is not None and date < after:
+        return True
+    return before is not None and date > before
+
+
 class FileSystemMetricsRepositoryMultipleResultsLoader(MetricsRepositoryMultipleResultsLoader):
     def __init__(self, repository: FileSystemMetricsRepository):
         super().__init__()
         self._repository = repository
 
     def _all_results(self) -> List[AnalysisResult]:
-        return self._repository._read_all()
+        # push the time window down: entries outside [after, before] are
+        # skipped BEFORE deserialization (get() re-applies the same filter
+        # on the survivors, which is then a no-op)
+        return self._repository._read_all(
+            after=self._after, before=self._before
+        )

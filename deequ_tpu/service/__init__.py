@@ -61,6 +61,13 @@ from .fleet import (
     fleet_enabled,
     mesh_substrate,
 )
+from .fleetwatch import (
+    FLEETWATCH_ENV,
+    FleetWatch,
+    HarvestReport,
+    WatchSpec,
+    fleetwatch_enabled,
+)
 from .metrics import MetricsExporter, ServiceMetrics
 from .placement import (
     PlacementRouter,
@@ -79,6 +86,8 @@ __all__ = [
     "FoldCoalescer", "CrossoverRouter",
     "FleetScheduler", "SubMeshLease", "fleet_enabled", "mesh_substrate",
     "FLEET_ENV",
+    "FleetWatch", "HarvestReport", "WatchSpec", "fleetwatch_enabled",
+    "FLEETWATCH_ENV",
     "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
     "TransientFailure", "SessionClosed", "ServiceClosed",
     "SchemaContract", "DriftReport", "SchemaDriftError",
@@ -149,6 +158,15 @@ class VerificationService:
         #: (DEEQU_TPU_COALESCE=0 bypasses it per ingest, exactly
         #: reproducing the serial path)
         self.coalescer = FoldCoalescer(self)
+        from .fleetwatch import FleetWatch
+
+        #: the standing fleet-scale anomaly watch: every scheduler harvest
+        #: of a WATCHED tenant re-scores the fleet's metric histories in
+        #: batched detect_batch calls and surfaces anomalies on the export
+        #: plane (DEEQU_TPU_FLEETWATCH=0 detaches the trigger; explicit
+        #: harvest_now() always works)
+        self.fleetwatch = FleetWatch(self)
+        self.fleetwatch.attach()
         self._sessions: Dict[Tuple[str, str], StreamingSession] = {}
         self._sessions_lock = threading.Lock()
         self._exporter: Optional[MetricsExporter] = None
@@ -370,6 +388,28 @@ class VerificationService:
             session = StreamingSession(self, tenant, dataset, checks, **kw)
             self._sessions[key] = session
             return session
+
+    # -- fleet watch ---------------------------------------------------------
+
+    def watch_metrics(
+        self,
+        tenant: str,
+        repository: Any,
+        analyzers,
+        strategy: Any = None,
+        dataset: str = "default",
+        tags: Optional[Dict[str, str]] = None,
+    ):
+        """Register a standing anomaly watch over ``tenant``'s committed
+        metric history (see `service.fleetwatch`): on every scheduler
+        harvest the fleet watch re-scores every watched series in batched
+        ``detect_batch`` calls and surfaces anomalies as
+        ``deequ_service_anomaly_*`` export series plus trace-correlated
+        flight dumps."""
+        return self.fleetwatch.watch(
+            tenant, repository, analyzers, strategy=strategy,
+            dataset=dataset, tags=tags,
+        )
 
     def get_session(
         self, tenant: str, dataset: str, include_closed: bool = False

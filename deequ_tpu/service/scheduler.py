@@ -213,6 +213,11 @@ class JobScheduler:
         #: coalesce keys under an ACTIVE drain: their jobs stay queued for
         #: bulk absorption instead of being picked (see _eligible)
         self._deferred: set = set()
+        #: harvest listeners (fn(tenant)) invoked OUTSIDE the queue lock
+        #: after every job harvest — the fleet watch's re-score trigger
+        #: (see service.fleetwatch). Append-only; registration races at
+        #: worst miss the in-flight harvest.
+        self._harvest_listeners: List[Callable[[str], None]] = []
         self.metrics.describe(
             "deequ_service_jobs_submitted_total", "Jobs accepted into the queue."
         )
@@ -908,6 +913,27 @@ class JobScheduler:
             # usually already did — this probe-and-repack is the backstop
             # for pass-level GSPMD failures that never named a device)
             self.fleet.note_shard_loss()
+        for listener in self._harvest_listeners:
+            # the fleet watch's trigger: a completed job means this tenant
+            # may have committed fresh metrics. Defensive — a raising
+            # listener must not take the harvested job down with it, and
+            # listeners run outside every scheduler lock (they typically
+            # re-enter submit())
+            try:
+                listener(tenant)
+            except Exception:  # noqa: BLE001 - observability only
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "harvest listener failed for tenant %s", tenant,
+                    exc_info=True,
+                )
+
+    def add_harvest_listener(self, fn: Callable[[str], None]) -> None:
+        """Register ``fn(tenant)`` to run after every job harvest (outside
+        the queue lock; exceptions are swallowed with a warning). The
+        fleet watch uses this as its standing re-score trigger."""
+        self._harvest_listeners.append(fn)
 
     def _maybe_retry(self, job: _Job, exc: BaseException) -> bool:
         from ..exceptions import ScanStallError

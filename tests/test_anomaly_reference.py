@@ -1,0 +1,454 @@
+"""Reference anomaly-suite ports + serial-vs-batched parity pins
+(ISSUE 15): the scenarios of `AnomalyDetectorTest.scala`,
+`RateOfChangeStrategyTest.scala`, `OnlineNormalStrategyTest.scala` and the
+`HoltWintersTest.scala` detection scenarios, each doubled with the
+batched ``detect_batch`` twin — flag indices, values AND messages must
+match element-for-element, including ragged fleets, per-series search
+intervals, and the anomaly-exclusion rollback subtlety."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.anomalydetection import (
+    AbsoluteChangeStrategy,
+    Anomaly,
+    AnomalyDetector,
+    BatchNormalStrategy,
+    DataPoint,
+    HoltWinters,
+    MetricInterval,
+    OnlineNormalStrategy,
+    RateOfChangeStrategy,
+    RelativeRateOfChangeStrategy,
+    SeriesSeasonality,
+    SimpleThresholdStrategy,
+)
+
+
+def assert_batched_matches_serial(strategy, fleet, intervals):
+    """The parity pin: one batched call == per-series serial calls,
+    element for element (indices, values, messages)."""
+    batched = strategy.detect_batch(fleet, intervals)
+    assert len(batched) == len(fleet)
+    if isinstance(intervals, tuple):
+        intervals = [intervals] * len(fleet)
+    for series, interval, got in zip(fleet, intervals, batched):
+        want = strategy.detect(series, interval)
+        assert [i for i, _ in got] == [i for i, _ in want]
+        for (_, ga), (_, wa) in zip(got, want):
+            assert float(ga.value) == float(wa.value)
+            assert ga.detail == wa.detail
+
+
+def ragged_fleet(n=24, seed=11, lo=15, hi=90):
+    rng = np.random.default_rng(seed)
+    fleet = []
+    for _ in range(n):
+        s = list(rng.normal(10, 2, int(rng.integers(lo, hi))))
+        for j in rng.integers(4, len(s), 3):
+            s[int(j)] += float(rng.choice([-1, 1])) * 40
+        fleet.append(s)
+    return fleet
+
+
+class TestAnomalyDetectorReference:
+    """`AnomalyDetectorTest.scala` scenarios."""
+
+    def test_history_must_not_be_empty(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=1.0))
+        with pytest.raises(ValueError):
+            detector.is_new_point_anomalous([], DataPoint(1, 1.0))
+
+    def test_new_point_must_be_after_history_range(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=1.0))
+        history = [DataPoint(t, 0.0) for t in range(5)]
+        with pytest.raises(ValueError):
+            detector.is_new_point_anomalous(history, DataPoint(4, 0.0))
+
+    def test_detects_only_in_search_interval(self):
+        """The reference feeds unsorted points and expects detection keyed
+        by TIMESTAMP, only inside the time interval."""
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=1.0))
+        points = [DataPoint(t, 5.0) for t in (4, 1, 3, 0, 2)]
+        result = detector.detect_anomalies_in_history(points, (2, 4))
+        assert [t for t, _ in result.anomalies] == [2, 3]
+
+    def test_none_metric_values_are_dropped(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=1.0))
+        points = [
+            DataPoint(0, 0.0), DataPoint(1, None), DataPoint(2, 5.0),
+        ]
+        result = detector.detect_anomalies_in_history(points)
+        assert [t for t, _ in result.anomalies] == [2]
+
+    def test_interval_start_after_end_raises(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=1.0))
+        with pytest.raises(ValueError):
+            detector.detect_anomalies_in_history(
+                [DataPoint(0, 0.0)], (5, 2)
+            )
+
+    def test_anomaly_equality_ignores_detail(self):
+        """`DetectionResult.scala`: anomalies compare by value +
+        confidence, not message."""
+        assert Anomaly(1.0, 1.0, "a") == Anomaly(1.0, 1.0, "b")
+        assert Anomaly(1.0, 1.0) != Anomaly(2.0, 1.0)
+
+
+class TestRateOfChangeReference:
+    """`RateOfChangeStrategyTest.scala` scenarios (RateOfChange is the
+    deprecated alias of AbsoluteChange)."""
+
+    DATA = [1.0, 2.0, 4.0, 1.0, 2.0, 8.0, 8.5, 9.0]
+
+    def test_detects_changes_beyond_both_bounds(self):
+        s = RateOfChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0)
+        found = [i for i, _ in s.detect(self.DATA, (0, len(self.DATA)))]
+        assert found == [3, 5]  # -3 drop and +6 jump
+
+    def test_upper_bound_only(self):
+        s = RateOfChangeStrategy(max_rate_increase=2.0)
+        found = [i for i, _ in s.detect(self.DATA, (0, len(self.DATA)))]
+        assert found == [5]
+
+    def test_lower_bound_only(self):
+        s = RateOfChangeStrategy(max_rate_decrease=-2.0)
+        found = [i for i, _ in s.detect(self.DATA, (0, len(self.DATA)))]
+        assert found == [3]
+
+    def test_search_interval_restricts_detection(self):
+        s = RateOfChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0)
+        assert [i for i, _ in s.detect(self.DATA, (4, 8))] == [5]
+
+    def test_order_two_derivative(self):
+        s = AbsoluteChangeStrategy(max_rate_increase=4.0, order=2)
+        data = [0.0, 1.0, 2.0, 3.0, 10.0, 17.0]
+        # second difference jumps by 6 at index 4
+        assert [i for i, _ in s.detect(data, (0, len(data)))] == [4]
+
+    def test_requires_some_bound(self):
+        with pytest.raises(ValueError):
+            RateOfChangeStrategy()
+
+    def test_bounds_must_be_ordered(self):
+        with pytest.raises(ValueError):
+            RateOfChangeStrategy(max_rate_decrease=2.0, max_rate_increase=-2.0)
+
+    def test_batched_parity_shared_interval(self):
+        s = RateOfChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0)
+        fleet = ragged_fleet(seed=21)
+        assert_batched_matches_serial(s, fleet, (0, 2 ** 62))
+
+    def test_batched_parity_per_series_intervals_and_orders(self):
+        for order in (1, 2):
+            s = AbsoluteChangeStrategy(
+                max_rate_decrease=-5.0, max_rate_increase=5.0, order=order
+            )
+            fleet = ragged_fleet(seed=22 + order)
+            intervals = [(max(order, len(f) // 2), len(f)) for f in fleet]
+            assert_batched_matches_serial(s, fleet, intervals)
+
+    def test_relative_rate_batched_parity(self):
+        s = RelativeRateOfChangeStrategy(max_rate_increase=1.5)
+        fleet = ragged_fleet(seed=31)
+        intervals = [(1, len(f)) for f in fleet]
+        assert_batched_matches_serial(s, fleet, intervals)
+
+    def test_relative_rate_order_zero_raises_batched_too(self):
+        s = RelativeRateOfChangeStrategy(max_rate_increase=1.5, order=0)
+        with pytest.raises(ValueError):
+            s.detect([1.0, 2.0], (0, 2))
+        with pytest.raises(ValueError):
+            s.detect_batch([[1.0, 2.0]], (0, 2))
+
+
+class TestOnlineNormalReference:
+    """`OnlineNormalStrategyTest.scala` scenarios, incl. the
+    anomaly-exclusion rollback and the search-interval non-rollback
+    subtlety."""
+
+    def _series(self, seed=0, n=100):
+        rng = np.random.default_rng(seed)
+        data = list(rng.normal(10.0, 1.0, n))
+        data[20] = 45.0
+        data[70] = -30.0
+        return data
+
+    def test_detects_planted_outliers(self):
+        s = OnlineNormalStrategy()
+        found = [i for i, _ in s.detect(self._series(), (0, 100))]
+        assert found == [20, 70]
+
+    def test_exclusion_rollback_keeps_later_points_detectable(self):
+        """With ignore_anomalies=True a flagged point is EXCLUDED from the
+        running stats (mean/variance roll back), so a back-to-back pair of
+        outliers both flag; without the rollback the first outlier widens
+        the band."""
+        rng = np.random.default_rng(1)
+        data = list(rng.normal(0.0, 1.0, 80))
+        data[40] = 100.0
+        data[41] = 100.0
+        with_rollback = OnlineNormalStrategy(ignore_anomalies=True)
+        found = [i for i, _ in with_rollback.detect(data, (0, 80))]
+        assert 40 in found and 41 in found
+        without = OnlineNormalStrategy(ignore_anomalies=False)
+        found_no = [i for i, _ in without.detect(data, (0, 80))]
+        # the un-rolled-back stats absorb the outliers into the band
+        assert len(found_no) <= len(found)
+
+    def test_points_outside_search_interval_never_roll_back(self):
+        """An out-of-interval outlier is neither FLAGGED nor excluded from
+        the stats — the stats at the interval's first point already
+        absorbed it (the reference's searchInterval contract)."""
+        data = [10.0] * 30 + [100.0] + [10.0] * 30
+        s = OnlineNormalStrategy(ignore_start_percentage=0.0)
+        full = s.compute_stats_and_anomalies(data, (0, len(data)))
+        windowed = s.compute_stats_and_anomalies(data, (40, len(data)))
+        assert full[30][2] and not windowed[30][2]  # flagged only in-window
+        # the windowed run's stats at index 31 INCLUDE the outlier (no
+        # rollback happened), so they differ from the full run's
+        assert windowed[31][0] != full[31][0]
+
+    def test_ignore_start_percentage(self):
+        data = [1000.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+        s = OnlineNormalStrategy(ignore_start_percentage=0.2)
+        found = [i for i, _ in s.detect(data, (0, len(data)))]
+        assert 0 not in found and 1 not in found
+
+    def test_factor_validation(self):
+        with pytest.raises(ValueError):
+            OnlineNormalStrategy(
+                lower_deviation_factor=None, upper_deviation_factor=None
+            )
+        with pytest.raises(ValueError):
+            OnlineNormalStrategy(lower_deviation_factor=-1.0)
+        with pytest.raises(ValueError):
+            OnlineNormalStrategy(ignore_start_percentage=1.5)
+
+    def test_batched_parity_ragged_fleet_all_variants(self):
+        fleet = ragged_fleet(seed=41)
+        for strat in (
+            OnlineNormalStrategy(),
+            OnlineNormalStrategy(ignore_anomalies=False),
+            OnlineNormalStrategy(
+                lower_deviation_factor=None, upper_deviation_factor=2.0,
+                ignore_start_percentage=0.25,
+            ),
+        ):
+            assert_batched_matches_serial(strat, fleet, (0, 2 ** 62))
+
+    def test_batched_parity_per_series_newest_point_intervals(self):
+        """The fleet-watch shape: every series judged at its OWN newest
+        index — including the rollback bookkeeping up to that point."""
+        fleet = ragged_fleet(seed=42)
+        intervals = [(len(f) - 1, len(f)) for f in fleet]
+        assert_batched_matches_serial(
+            OnlineNormalStrategy(), fleet, intervals
+        )
+
+    def test_batched_rollback_pins_exact_stats(self):
+        """Rollback parity at the STATS level: the batched recurrence's
+        mean/std after an excluded anomaly equals the scalar path's,
+        bitwise."""
+        data = [10.0] * 20 + [90.0] + [10.0] * 20
+        s = OnlineNormalStrategy(ignore_start_percentage=0.0)
+        scalar = s.compute_stats_and_anomalies(data, (0, len(data)))
+        means, stds, flags = s.compute_stats_batch(
+            np.asarray(data)[None, :], search_interval=(0, len(data))
+        )
+        for k, (mean, std, flagged) in enumerate(scalar):
+            assert means[0, k] == mean
+            assert stds[0, k] == std
+            assert bool(flags[0, k]) == flagged
+
+
+class TestBatchNormalReference:
+    def test_basis_excludes_search_interval(self):
+        rng = np.random.default_rng(2)
+        data = list(rng.normal(5.0, 1.0, 50)) + [5.0, 30.0]
+        s = BatchNormalStrategy()
+        assert [i for i, _ in s.detect(data, (50, 52))] == [51]
+
+    def test_include_interval_uses_whole_series(self):
+        data = [1.0, 1.0, 1.0, 1.0, 100.0]
+        found = BatchNormalStrategy(include_interval=True).detect(data, (4, 5))
+        assert [i for i, _ in found] == []
+
+    def test_batched_parity(self):
+        fleet = ragged_fleet(seed=51)
+        intervals = [(len(f) // 2, len(f)) for f in fleet]
+        assert_batched_matches_serial(BatchNormalStrategy(), fleet, intervals)
+        assert_batched_matches_serial(
+            BatchNormalStrategy(include_interval=True), fleet, intervals
+        )
+
+    def test_batched_empty_series_raises_like_serial(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy().detect_batch([[]], (0, 1))
+
+
+class TestSimpleThresholdBatched:
+    def test_batched_parity(self):
+        fleet = ragged_fleet(seed=61)
+        intervals = [(0, len(f)) for f in fleet]
+        assert_batched_matches_serial(
+            SimpleThresholdStrategy(upper_bound=12.0, lower_bound=8.0),
+            fleet, intervals,
+        )
+
+    def test_interval_validation_matches_serial(self):
+        s = SimpleThresholdStrategy(upper_bound=1.0)
+        with pytest.raises(ValueError):
+            s.detect_batch([[1.0]], (5, 2))
+        with pytest.raises(ValueError):
+            s.detect_batch([[1.0], [1.0]], [(0, 1), (5, 2)])
+
+
+class TestHoltWintersReference:
+    """`HoltWintersTest.scala` detection scenarios + the batched twin."""
+
+    @staticmethod
+    def weekly_series(weeks=6, seed=3, noise=0.2):
+        rng = np.random.default_rng(seed)
+        pattern = [10.0, 12.0, 14.0, 13.0, 11.0, 5.0, 4.0]
+        return [
+            v + float(rng.normal(0, noise))
+            for _ in range(weeks)
+            for v in pattern
+        ]
+
+    def test_detects_break_in_weekly_pattern(self):
+        series = self.weekly_series()
+        series[-2] += 30.0
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        found = [i for i, _ in hw.detect(series, (35, 42))]
+        assert len(series) - 2 in found
+
+    def test_break_flags_only_with_the_break(self):
+        """The broken day flags; the same series WITHOUT the break does
+        not flag that day (the clean-vs-corrupt pair the reference
+        scenario pins — small-noise days may flag either way, the break
+        day is the discriminator)."""
+        clean = self.weekly_series(seed=4)
+        broken = list(clean)
+        broken[-2] += 30.0
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        day = len(clean) - 2
+        assert day in [i for i, _ in hw.detect(broken, (35, 42))]
+        assert day not in [i for i, _ in hw.detect(clean, (35, 42))]
+
+    def test_yearly_monthly_periodicity(self):
+        rng = np.random.default_rng(5)
+        series = [
+            50.0 + 10 * np.sin(2 * np.pi * (i % 12) / 12)
+            + float(rng.normal(0, 0.3))
+            for i in range(48)
+        ]
+        series[-1] += 60.0
+        hw = HoltWinters(MetricInterval.MONTHLY, SeriesSeasonality.YEARLY)
+        found = [i for i, _ in hw.detect(series, (47, 48))]
+        assert found == [47]
+
+    def test_unsupported_period_combo_raises(self):
+        with pytest.raises(ValueError):
+            HoltWinters(MetricInterval.MONTHLY, SeriesSeasonality.WEEKLY)
+
+    def test_validations(self):
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        with pytest.raises(ValueError):
+            hw.detect([], (0, 10))
+        with pytest.raises(ValueError):
+            hw.detect([1.0] * 30, (20, 10))
+        with pytest.raises(ValueError):
+            hw.detect([1.0] * 30, (-1, 10))
+        with pytest.raises(ValueError):
+            hw.detect([1.0] * 30, (7, 20))  # < two full cycles of training
+
+    def test_batched_parity_ragged_fleet(self):
+        """Ragged fleets with per-series newest-week intervals: flags,
+        values and messages element-identical to serial (the fitted
+        parameters come from the same per-series optimizer calls; the
+        RECURRENCES are what batch)."""
+        rng = np.random.default_rng(6)
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        fleet = []
+        for k in range(8):
+            weeks = int(rng.integers(4, 7))
+            s = self.weekly_series(weeks=weeks, seed=100 + k)
+            if k % 2 == 0:
+                s[-1] += 25.0
+            fleet.append(s)
+        intervals = [(len(s) - 7, len(s)) for s in fleet]
+        assert_batched_matches_serial(hw, fleet, intervals)
+
+    def test_batched_accepts_cached_params(self):
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        fleet = [self.weekly_series(seed=7), self.weekly_series(seed=8)]
+        fleet[0][-1] += 30.0
+        intervals = [(len(s) - 7, len(s)) for s in fleet]
+        params = hw.fit_batch(fleet, intervals)
+        got = hw.detect_batch(fleet, intervals, params=params)
+        want = hw.detect_batch(fleet, intervals)
+        assert [[i for i, _ in rows] for rows in got] == [
+            [i for i, _ in rows] for rows in want
+        ]
+        assert (len(fleet[0]) - 1) in [i for i, _ in got[0]]
+
+    def test_batch_core_matches_scalar_recurrence(self):
+        """`additive_holt_winters_batch` == `additive_holt_winters`
+        bitwise on forecasts AND residuals, across parameter corners and
+        ragged training lengths."""
+        from deequ_tpu.anomalydetection.seasonal import (
+            additive_holt_winters,
+            additive_holt_winters_batch,
+        )
+
+        rng = np.random.default_rng(9)
+        m = 7
+        trainings = [
+            list(rng.normal(20, 3, int(rng.integers(2 * m, 6 * m))))
+            for _ in range(10)
+        ]
+        params = [
+            (float(a), float(b), float(g))
+            for a, b, g in rng.uniform(0.01, 0.99, (10, 3))
+        ]
+        nfs = [int(rng.integers(1, 8)) for _ in range(10)]
+        tl = np.array([len(t) for t in trainings])
+        width = int(tl.max())
+        mat = np.zeros((10, width))
+        for i, t in enumerate(trainings):
+            mat[i, : len(t)] = t
+        res = additive_holt_winters_batch(
+            mat, tl, m, np.array(nfs),
+            np.array([p[0] for p in params]),
+            np.array([p[1] for p in params]),
+            np.array([p[2] for p in params]),
+        )
+        for i, training in enumerate(trainings):
+            want = additive_holt_winters(training, m, nfs[i], *params[i])
+            got_fc = res.forecasts[i, : nfs[i]]
+            assert got_fc.tolist() == pytest.approx(want.forecasts, abs=0.0)
+            got_res = res.residuals[i, : len(training)]
+            assert got_res.tolist() == pytest.approx(want.residuals, abs=0.0)
+
+
+class TestDefaultDetectBatch:
+    def test_any_strategy_is_batchable_via_the_base_loop(self):
+        """A strategy with no specialized override still batches (the
+        fleet watch's contract: every bundle makes ONE call)."""
+
+        from deequ_tpu.anomalydetection import AnomalyDetectionStrategy
+
+        class EveryThird(AnomalyDetectionStrategy):
+            def detect(self, data_series, search_interval):
+                start, end = search_interval
+                return [
+                    (i, Anomaly(data_series[i], 1.0))
+                    for i in range(start, min(end, len(data_series)))
+                    if i % 3 == 0
+                ]
+
+        fleet = [[1.0] * 7, [2.0] * 4]
+        got = EveryThird().detect_batch(fleet, [(0, 7), (1, 4)])
+        assert [[i for i, _ in rows] for rows in got] == [[0, 3, 6], [3]]

@@ -1,0 +1,534 @@
+"""Fleet watch (ISSUE 15 tentpole): the standing batched anomaly plane —
+scheduler-harvest trigger, one detect_batch call per strategy bundle,
+deequ_service_anomaly_* export series, poisoned-history quarantine
+isolation, trace-correlated flight dumps."""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Mean, Size
+from deequ_tpu.anomalydetection import (
+    AbsoluteChangeStrategy,
+    OnlineNormalStrategy,
+)
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.data import Dataset
+from deequ_tpu.metrics import DoubleMetric, Entity, Success
+from deequ_tpu.repository import PartitionedMetricsRepository, ResultKey
+from deequ_tpu.runners import AnalysisRunner
+from deequ_tpu.runners.context import AnalyzerContext
+from deequ_tpu.service import VerificationService
+from deequ_tpu.service.fleetwatch import (
+    FleetWatch,
+    fleetwatch_bundle_size,
+    fleetwatch_window_months,
+    window_after_ms,
+)
+
+DAY_MS = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def steady_ctx():
+    data = Dataset.from_dict(
+        {"x": np.random.default_rng(0).normal(10, 1, 128)}
+    )
+    return AnalysisRunner.do_analysis_run(
+        data, [Size(), Completeness("x"), Mean("x")]
+    )
+
+
+def wild_ctx(steady, value=999.0):
+    return AnalyzerContext({
+        **{a: m for a, m in steady.metric_map.items() if a != Mean("x")},
+        Mean("x"): DoubleMetric(Entity.COLUMN, "Mean", "x", Success(value)),
+    })
+
+
+def history_repo(tmp_path, name, steady, days=30, wild_newest=False):
+    repo = PartitionedMetricsRepository(str(tmp_path / name))
+    now = int(time.time() * 1000)
+    for day in range(days):
+        repo.save(ResultKey(now - (days - day) * DAY_MS), steady)
+    repo.save(
+        ResultKey(now), wild_ctx(steady) if wild_newest else steady
+    )
+    return repo
+
+
+@pytest.fixture
+def service():
+    with VerificationService(
+        workers=2, background_warm=False, fleet=False
+    ) as svc:
+        yield svc
+
+
+class TestHarvestScoring:
+    def test_scores_fleet_and_flags_wild_tenant(self, tmp_path, service, steady_ctx):
+        service.watch_metrics(
+            "t-steady", history_repo(tmp_path, "a", steady_ctx),
+            [Size(), Mean("x")],
+        )
+        service.watch_metrics(
+            "t-wild",
+            history_repo(tmp_path, "b", steady_ctx, wild_newest=True),
+            [Size(), Mean("x")],
+        )
+        report = service.fleetwatch.harvest_now()
+        assert report.tenants == 2
+        assert report.series_scored == 4
+        assert report.detect_calls == 1  # ONE bundle, one batched call
+        flagged_tenants = {f[0] for f in report.flagged}
+        assert flagged_tenants == {"t-wild"}
+        assert any("Mean" in f[2] for f in report.flagged)
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_flagged_total"][
+            "tenant=t-wild"
+        ] >= 1
+        assert snap["deequ_service_anomaly_series_scored_total"][
+            "tenant=t-steady"
+        ] == 2
+        assert snap["deequ_service_anomaly_harvests_total"] == 1
+        assert snap["deequ_service_anomaly_scoring_seconds_total"] > 0
+
+    def test_one_call_per_strategy_bundle(self, tmp_path, service, steady_ctx):
+        """Two strategies = two bundles = two calls, regardless of tenant
+        count."""
+        repo = history_repo(tmp_path, "a", steady_ctx)
+        service.watch_metrics(
+            "t1", repo, [Size(), Mean("x")], strategy=OnlineNormalStrategy()
+        )
+        service.watch_metrics(
+            "t2", repo, [Size(), Mean("x")], strategy=OnlineNormalStrategy(),
+            dataset="d2",
+        )
+        service.watch_metrics(
+            "t3", repo, [Mean("x")],
+            strategy=AbsoluteChangeStrategy(max_rate_increase=100.0),
+        )
+        report = service.fleetwatch.harvest_now()
+        assert report.series_scored == 5
+        assert report.detect_calls == 2
+
+    def test_bundle_size_knob_chunks(self, tmp_path, service, steady_ctx, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_FLEETWATCH_BUNDLE", "1")
+        assert fleetwatch_bundle_size() == 1
+        service.watch_metrics(
+            "t1", history_repo(tmp_path, "a", steady_ctx),
+            [Size(), Mean("x")],
+        )
+        report = service.fleetwatch.harvest_now()
+        assert report.detect_calls == 2  # one per series at bundle=1
+
+    def test_standing_anomaly_exports_and_dumps_once(
+        self, tmp_path, service, steady_ctx, monkeypatch
+    ):
+        """A persistently anomalous newest point stays in every harvest's
+        REPORT but bumps the export counter and schedules a flight dump
+        exactly once — re-dumping per harvest would drain the recorder's
+        process-wide dump budget and inflate the counter by harvest
+        rate."""
+        flight_dir = str(tmp_path / "flight")
+        monkeypatch.setenv("DEEQU_TPU_FLIGHT_DIR", flight_dir)
+        service.watch_metrics(
+            "t-wild",
+            history_repo(tmp_path, "a", steady_ctx, wild_newest=True),
+            [Mean("x")],
+        )
+        first = service.fleetwatch.harvest_now()
+        second = service.fleetwatch.harvest_now()
+        assert first.flagged and second.flagged == first.flagged
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_flagged_total"][
+            "tenant=t-wild"
+        ] == 1
+        dumps = [
+            p for p in glob.glob(os.path.join(flight_dir, "*.jsonl"))
+            if "AnomalyFlagged" in open(p).read()
+        ]
+        assert len(dumps) == 1
+
+    def test_short_holtwinters_tenant_does_not_degrade_its_bundle(
+        self, tmp_path, service, steady_ctx
+    ):
+        """One tenant younger than two full cycles is pre-filtered
+        (skipped), keeping the rest of the Holt-Winters bundle on the ONE
+        batched call."""
+        from deequ_tpu.anomalydetection import (
+            HoltWinters, MetricInterval, SeriesSeasonality,
+        )
+
+        long_repo = history_repo(tmp_path, "a", steady_ctx, days=40)
+        short_repo = PartitionedMetricsRepository(str(tmp_path / "short"))
+        now = int(time.time() * 1000)
+        for d in range(10):  # < 2 weekly cycles + 1
+            short_repo.save(ResultKey(now - (10 - d) * DAY_MS), steady_ctx)
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        service.watch_metrics("t-long", long_repo, [Mean("x")], strategy=hw)
+        service.watch_metrics("t-young", short_repo, [Mean("x")], strategy=hw)
+        report = service.fleetwatch.harvest_now()
+        assert report.detect_calls == 1  # the bundle stayed batched
+        assert report.series_scored == 1
+        assert report.series_skipped == 1
+
+    def test_holtwinters_fits_cache_across_harvests(
+        self, tmp_path, service, steady_ctx, monkeypatch
+    ):
+        """The per-series L-BFGS-B fit (the dominant serial cost) runs
+        once per training slice, not once per harvest: an unchanged
+        history re-scores with ZERO new optimizer calls; a new committed
+        point re-fits exactly that series."""
+        from deequ_tpu.anomalydetection import (
+            HoltWinters, MetricInterval, SeriesSeasonality,
+        )
+
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        repo = history_repo(tmp_path, "a", steady_ctx, days=30)
+        service.watch_metrics("t1", repo, [Mean("x")], strategy=hw)
+        calls = []
+        real_fit = HoltWinters._fit
+        monkeypatch.setattr(
+            HoltWinters, "_fit",
+            lambda self, training, nf: calls.append(1) or real_fit(
+                self, training, nf
+            ),
+        )
+        first = service.fleetwatch.harvest_now()
+        assert first.series_scored == 1 and len(calls) == 1
+        second = service.fleetwatch.harvest_now()
+        assert second.series_scored == 1 and len(calls) == 1  # cached
+        # flags identical with and without the cache in play
+        assert second.flagged == first.flagged
+        repo.save(ResultKey(int(time.time() * 1000) + 1), steady_ctx)
+        service.fleetwatch.harvest_now()
+        assert len(calls) == 2  # the grown history re-fits
+
+    def test_short_history_skipped_not_fatal(self, tmp_path, service, steady_ctx):
+        repo = PartitionedMetricsRepository(str(tmp_path / "short"))
+        repo.save(ResultKey(int(time.time() * 1000)), steady_ctx)
+        service.watch_metrics("t-short", repo, [Mean("x")])
+        report = service.fleetwatch.harvest_now()
+        assert report.series_scored == 0
+        assert report.series_skipped == 1
+
+    def test_unwatch(self, tmp_path, service, steady_ctx):
+        service.watch_metrics(
+            "t1", history_repo(tmp_path, "a", steady_ctx), [Mean("x")]
+        )
+        assert service.fleetwatch.unwatch("t1")
+        assert not service.fleetwatch.unwatch("t1")
+        assert service.fleetwatch.harvest_now().series_scored == 0
+
+
+class TestStandingTrigger:
+    def test_scheduler_harvest_triggers_scoring_job(self, tmp_path, steady_ctx):
+        """The standing-watch contract: a completed job for a WATCHED
+        tenant schedules ONE debounced fleet-scoring job; unwatched
+        tenants never trigger."""
+        data = Dataset.from_dict({"x": [1.0, 2.0, 3.0]})
+        checks = [Check(CheckLevel.ERROR, "c").has_size(lambda n: n > 0)]
+        with VerificationService(
+            workers=2, background_warm=False, fleet=False
+        ) as svc:
+            svc.watch_metrics(
+                "watched", history_repo(tmp_path, "a", steady_ctx),
+                [Mean("x")],
+            )
+            svc.verify(data, checks, tenant="unwatched", timeout=60)
+            time.sleep(0.3)
+            assert svc.fleetwatch.last_report is None
+            svc.verify(data, checks, tenant="watched", timeout=60)
+            for _ in range(100):
+                if svc.fleetwatch.last_report is not None:
+                    break
+                time.sleep(0.05)
+            report = svc.fleetwatch.last_report
+            assert report is not None and report.series_scored == 1
+            snap = svc.json_snapshot()["counters"]
+            assert snap["deequ_service_anomaly_harvests_total"] >= 1
+
+    def test_watch_survives_scoring_job_killed_before_body(
+        self, tmp_path, steady_ctx
+    ):
+        """Liveness: a scoring job that dies BEFORE its body runs (the
+        injected worker fault fires between pickup and fn) must not leak
+        the debounce flag — the next harvest re-schedules and the fleet
+        keeps being scored."""
+        from deequ_tpu.reliability import FaultSpec, inject
+
+        data = Dataset.from_dict({"x": [1.0, 2.0, 3.0]})
+        checks = [Check(CheckLevel.ERROR, "c").has_size(lambda n: n > 0)]
+        with VerificationService(
+            workers=1, background_warm=False, fleet=False
+        ) as svc:
+            svc.watch_metrics(
+                "watched", history_repo(tmp_path, "a", steady_ctx),
+                [Mean("x")],
+            )
+            # every worker pickup crashes while armed: the triggering job
+            # retries through its own budget; the scoring job (retries=0)
+            # dies pre-body and must clear _job_pending via recover_fn
+            with inject(
+                FaultSpec("worker", "worker_death", every=1, count=2)
+            ):
+                try:
+                    svc.verify(data, checks, tenant="watched", timeout=60,
+                               max_retries=0)
+                except Exception:  # noqa: BLE001 - the crash is the point
+                    pass
+                deadline = time.time() + 5
+                while time.time() < deadline and svc.fleetwatch._job_pending:
+                    time.sleep(0.05)
+            assert not svc.fleetwatch._job_pending
+            # disarmed: the watch schedules and scores normally again
+            svc.verify(data, checks, tenant="watched", timeout=60)
+            for _ in range(100):
+                if svc.fleetwatch.last_report is not None:
+                    break
+                time.sleep(0.05)
+            assert svc.fleetwatch.last_report is not None
+
+    def test_disabled_knob_detaches(self, tmp_path, steady_ctx, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_FLEETWATCH", "0")
+        data = Dataset.from_dict({"x": [1.0, 2.0, 3.0]})
+        checks = [Check(CheckLevel.ERROR, "c").has_size(lambda n: n > 0)]
+        with VerificationService(
+            workers=2, background_warm=False, fleet=False
+        ) as svc:
+            svc.watch_metrics(
+                "watched", history_repo(tmp_path, "a", steady_ctx),
+                [Mean("x")],
+            )
+            svc.verify(data, checks, tenant="watched", timeout=60)
+            time.sleep(0.3)
+            assert svc.fleetwatch.last_report is None
+            # explicit scoring still works
+            assert svc.fleetwatch.harvest_now().series_scored == 1
+
+
+class TestQuarantineIsolation:
+    def test_poisoned_history_quarantines_typed_others_unaffected(
+        self, tmp_path, service, steady_ctx
+    ):
+        """ISSUE 15 reliability leg: one tenant's corrupt history bucket
+        quarantines (typed, counted) while the other tenants' scores are
+        byte-identical to a clean run."""
+        service.watch_metrics(
+            "t-clean", history_repo(tmp_path, "a", steady_ctx, wild_newest=True),
+            [Size(), Mean("x")],
+        )
+        poisoned = history_repo(tmp_path, "b", steady_ctx)
+        service.watch_metrics("t-poisoned", poisoned, [Size(), Mean("x")])
+        clean_report = service.fleetwatch.harvest_now()
+        assert not clean_report.quarantined_tenants
+        # flip one byte inside one of the poisoned tenant's stored entries
+        # (valid JSON, failing checksum — the bit-rot shape)
+        [entry] = sorted(glob.glob(
+            os.path.join(poisoned.path, "*", "e-*.json")
+        ))[-1:]
+        raw = open(entry).read()
+        i = raw.index("Mean") + 1
+        open(entry, "w").write(
+            raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+        )
+        report = service.fleetwatch.harvest_now()
+        assert report.quarantined_tenants == ["t-poisoned"]
+        # the clean tenant's flags are unchanged
+        assert (
+            [f for f in report.flagged if f[0] == "t-clean"]
+            == [f for f in clean_report.flagged if f[0] == "t-clean"]
+        )
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_quarantined_total"][
+            "tenant=t-poisoned"
+        ] == 1
+        # the corrupt LOOSE entry self-healed on the quarantining read
+        # (bytes preserved in the sidecar): the next harvest loads clean,
+        # the standing episode closes, and the counter stays put
+        again = service.fleetwatch.harvest_now()
+        assert again.quarantined_tenants == []
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_quarantined_total"][
+            "tenant=t-poisoned"
+        ] == 1
+
+    def test_standing_quarantine_episode_counts_once(
+        self, tmp_path, service, steady_ctx
+    ):
+        """A corruption that re-quarantines on EVERY load (injected at the
+        repository_load site, so no self-heal) reports per harvest but
+        exports one counter bump per episode, not per harvest."""
+        from deequ_tpu.reliability import FaultSpec, inject
+
+        service.watch_metrics(
+            "t1", history_repo(tmp_path, "a", steady_ctx), [Mean("x")]
+        )
+        with inject(
+            FaultSpec("repository_load", "corrupt", every=1, count=None)
+        ):
+            first = service.fleetwatch.harvest_now()
+            second = service.fleetwatch.harvest_now()
+        assert first.quarantined_tenants == ["t1"]
+        assert second.quarantined_tenants == ["t1"]
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_quarantined_total"][
+            "tenant=t1"
+        ] == 1
+        # a clean harvest closes the episode; fresh corruption counts anew
+        service.fleetwatch.harvest_now()
+        with inject(
+            FaultSpec("repository_load", "corrupt", every=1, count=None)
+        ):
+            service.fleetwatch.harvest_now()
+        snap = service.json_snapshot()["counters"]
+        assert snap["deequ_service_anomaly_quarantined_total"][
+            "tenant=t1"
+        ] == 2
+
+    def test_concurrent_foreign_quarantine_is_not_misattributed(
+        self, tmp_path, service, steady_ctx
+    ):
+        """Attribution is per REPOSITORY: a quarantine happening elsewhere
+        in the process while a clean tenant's history loads (another
+        worker hitting a corrupt store) must not flag THIS tenant."""
+        from deequ_tpu.repository import FileSystemMetricsRepository
+
+        corrupt_path = tmp_path / "foreign.json"
+        corrupt_path.write_text('[{"torn"')
+        foreign = FileSystemMetricsRepository(str(corrupt_path))
+        inner = history_repo(tmp_path, "a", steady_ctx)
+
+        class InterleavingRepo:
+            """Simulates a concurrent worker quarantining a FOREIGN store
+            mid-load (deterministically, inside this tenant's load)."""
+
+            @property
+            def quarantines(self):
+                return inner.quarantines
+
+            def load(self):
+                foreign._read_all()  # bumps the process-global counter
+                return inner.load()
+
+        service.watch_metrics("t-clean", InterleavingRepo(), [Mean("x")])
+        report = service.fleetwatch.harvest_now()
+        assert report.quarantined_tenants == []
+        assert report.series_scored == 1
+
+    def test_injected_corrupt_fault_quarantines(self, tmp_path, service, steady_ctx):
+        from deequ_tpu.reliability import FaultSpec, inject
+
+        service.watch_metrics(
+            "t1", history_repo(tmp_path, "a", steady_ctx), [Mean("x")]
+        )
+        with inject(
+            FaultSpec("repository_load", "corrupt", at=1)
+        ) as inj:
+            report = service.fleetwatch.harvest_now()
+        assert inj.fired
+        assert report.quarantined_tenants == ["t1"]
+
+
+class TestObservability:
+    def test_flight_dump_correlates_to_harvest_trace(
+        self, tmp_path, service, steady_ctx, monkeypatch
+    ):
+        flight_dir = str(tmp_path / "flight")
+        monkeypatch.setenv("DEEQU_TPU_FLIGHT_DIR", flight_dir)
+        service.watch_metrics(
+            "t-wild",
+            history_repo(tmp_path, "a", steady_ctx, wild_newest=True),
+            [Mean("x")],
+        )
+        report = service.fleetwatch.harvest_now()
+        assert report.flagged
+        dumps = glob.glob(os.path.join(flight_dir, "*.jsonl"))
+        assert dumps
+        correlated = []
+        for path in dumps:
+            records = [json.loads(line) for line in open(path)]
+            header = records[0]
+            if any(
+                f.get("kind") == "AnomalyFlagged"
+                for f in header.get("failures", [])
+            ):
+                correlated.append((header, records[1:]))
+        assert correlated, "no AnomalyFlagged dump"
+        header, spans = correlated[0]
+        # the dump is CORRELATED: its spans belong to the harvest trace
+        assert header["trace_id"]
+        assert any(
+            s.get("name") == "fleetwatch:harvest" for s in spans
+        )
+        detail = next(
+            f["detail"] for f in header["failures"]
+            if f["kind"] == "AnomalyFlagged"
+        )
+        assert "t-wild" in detail and "Mean" in detail
+
+    def test_export_help_lines_present(self, service):
+        text = service.prometheus_text()
+        for series in (
+            "deequ_service_anomaly_series_scored_total",
+            "deequ_service_anomaly_flagged_total",
+            "deequ_service_anomaly_quarantined_total",
+            "deequ_service_anomaly_harvests_total",
+            "deequ_service_anomaly_scoring_seconds_total",
+            "deequ_service_anomaly_watched_series",
+        ):
+            # the gauge always exports; counters export once touched — but
+            # HELP must be REGISTERED for all (statlint export-help)
+            assert series in service.metrics._help
+
+    def test_watched_series_gauge(self, tmp_path, service, steady_ctx):
+        repo = history_repo(tmp_path, "a", steady_ctx)
+        service.watch_metrics("t1", repo, [Size(), Mean("x")])
+        snap = service.json_snapshot()
+        assert snap["gauges"]["deequ_service_anomaly_watched_series"] == 2
+
+
+class TestWindowKnob:
+    def test_window_after_ms_arithmetic(self):
+        import datetime
+
+        def utc_ms(y, m, d):
+            return int(datetime.datetime(
+                y, m, d, tzinfo=datetime.timezone.utc
+            ).timestamp() * 1000)
+
+        now = utc_ms(2026, 8, 4)
+        # 12-month window -> first ms of the month 11 buckets back
+        assert window_after_ms(12, now) == utc_ms(2025, 9, 1)
+        # 1-month window -> the current (partial) month counts
+        assert window_after_ms(1, now) == utc_ms(2026, 8, 1)
+        # a window crossing a year boundary
+        assert window_after_ms(3, utc_ms(2026, 1, 15)) == utc_ms(2025, 11, 1)
+        assert window_after_ms(0) is None
+
+    def test_window_bounds_history_load(self, tmp_path, service, steady_ctx, monkeypatch):
+        """Entries older than the window never score (and never even
+        deserialize — the partitioned walk skips their buckets)."""
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        now = int(time.time() * 1000)
+        # 10 recent dailies + 10 two years old
+        for day in range(10):
+            repo.save(ResultKey(now - (10 - day) * DAY_MS), steady_ctx)
+            repo.save(
+                ResultKey(now - 730 * DAY_MS - day * DAY_MS), steady_ctx
+            )
+        service.watch_metrics("t1", repo, [Mean("x")])
+        monkeypatch.setenv("DEEQU_TPU_FLEETWATCH_WINDOW_MONTHS", "2")
+        assert fleetwatch_window_months() == 2
+        repo.entries_deserialized = 0
+        service.fleetwatch.harvest_now()
+        assert repo.entries_deserialized == 10  # the stale decade untouched
+        monkeypatch.setenv("DEEQU_TPU_FLEETWATCH_WINDOW_MONTHS", "0")
+        repo.entries_deserialized = 0
+        service.fleetwatch.harvest_now()
+        assert repo.entries_deserialized == 20

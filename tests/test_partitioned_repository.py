@@ -1,0 +1,403 @@
+"""Time-partitioned metrics repository (ISSUE 15 tentpole) + the FS
+windowed-load satellite: O(queried window) pins, compaction, replace-key,
+quarantine, JVM-dialect import."""
+
+import datetime
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Mean, Size
+from deequ_tpu.data import Dataset
+from deequ_tpu.repository import (
+    AnalysisResult,
+    FileSystemMetricsRepository,
+    PartitionedMetricsRepository,
+    ResultKey,
+    month_bucket,
+)
+from deequ_tpu.runners import AnalysisRunner
+
+DAY_MS = 86_400_000
+BASE_MS = 1_735_689_600_000  # 2025-01-01T00:00Z
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    data = Dataset.from_dict(
+        {"x": np.random.default_rng(0).normal(10, 2, 64)}
+    )
+    return AnalysisRunner.do_analysis_run(
+        data, [Size(), Completeness("x"), Mean("x")]
+    )
+
+
+def populate(repo, days, ctx, tags=None):
+    for d in range(days):
+        repo.save(ResultKey(BASE_MS + d * DAY_MS, tags or {}), ctx)
+
+
+class TestLayout:
+    def test_month_bucket(self):
+        assert month_bucket(BASE_MS) == "2025-01"
+        assert month_bucket(BASE_MS + 40 * DAY_MS) == "2025-02"
+        assert month_bucket(0) == "1970-01"
+
+    def test_entries_land_in_month_buckets(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        populate(repo, 90, ctx)
+        assert repo.buckets() == ["2025-01", "2025-02", "2025-03"]
+        assert len(repo.load().get()) == 90
+
+    def test_windowed_load_walks_only_intersecting_buckets(self, tmp_path, ctx):
+        """THE O(queried window) pin: a one-month query over a year of
+        dailies walks ONE bucket and deserializes exactly the window's
+        entries — never the other 11 months'."""
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        populate(repo, 365, ctx)
+        assert len(repo.buckets()) == 12  # 2025-01 .. 2025-12
+        lo = BASE_MS + 150 * DAY_MS
+        hi = BASE_MS + 170 * DAY_MS
+        repo.entries_deserialized = 0
+        repo.buckets_walked = 0
+        got = repo.load().after(lo).before(hi).get()
+        assert len(got) == 21
+        assert repo.buckets_walked <= 2  # the window straddles <= 2 months
+        assert repo.entries_deserialized <= 62  # walked buckets' entries,
+        # never the year's 365 (in-bucket entries outside the bounds are
+        # peeked and skipped, not deserialized)
+
+    def test_save_is_append_not_full_rewrite(self, tmp_path, ctx):
+        """A save touches its own month bucket only — the legacy layout's
+        O(all history) rewrite is gone."""
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        populate(repo, 60, ctx)
+        jan = tmp_path / "hist" / "2025-01"
+        before = sorted(os.listdir(jan))
+        repo.save(ResultKey(BASE_MS + 45 * DAY_MS), ctx)  # lands in Feb
+        assert sorted(os.listdir(jan)) == before
+
+
+class TestCompaction:
+    def test_bucket_compacts_past_threshold(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=8
+        )
+        populate(repo, 20, ctx)
+        jan = tmp_path / "hist" / "2025-01"
+        files = os.listdir(jan)
+        loose = [f for f in files if f.startswith("e-")]
+        assert "compacted.json" in files
+        assert len(loose) < 8  # compaction keeps loose files bounded
+        assert len(repo.load().get()) == 20  # nothing lost
+
+    def test_explicit_compact_merges_and_dedups(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        populate(repo, 5, ctx)
+        n = repo.compact("2025-01")
+        assert n == 5
+        jan = tmp_path / "hist" / "2025-01"
+        assert [f for f in os.listdir(jan) if f.startswith("e-")] == []
+        assert len(repo.load().get()) == 5
+
+    def test_stale_loose_entry_never_wins_after_failed_removal(
+        self, tmp_path, ctx, monkeypatch
+    ):
+        """Best-effort removal of a replaced entry FAILING must not let
+        the stale entry serve beside — or, after compaction, instead of —
+        its replacement: loose names sort by recency and reads merge
+        last-wins per key."""
+        from deequ_tpu import io as dio
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.runners import AnalysisRunner
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        key = ResultKey(BASE_MS, {"env": "prod"})
+        repo.save(key, ctx)
+        new_ctx = AnalysisRunner.do_analysis_run(
+            Dataset.from_dict({"y": [1.0, 2.0]}), [Size()]
+        )
+        monkeypatch.setattr(
+            dio, "remove_file",
+            lambda path: (_ for _ in ()).throw(OSError("readonly")),
+        )
+        repo.save(key, new_ctx)  # removal of the old loose entry fails
+        monkeypatch.undo()
+        got = repo.load().get()
+        assert len(got) == 1  # never a duplicate
+        assert got[0].analyzer_context.metric_map[Size()].value.get() == 2.0
+        repo.compact(month_bucket(BASE_MS))
+        got = repo.load().get()
+        assert len(got) == 1
+        assert got[0].analyzer_context.metric_map[Size()].value.get() == 2.0
+
+    def test_compaction_stamp_beats_stale_merged_loose_file(
+        self, tmp_path, ctx, monkeypatch
+    ):
+        """A loose file compaction merged but failed to REMOVE predates
+        the compaction stamp, so it can never shadow a newer compacted
+        replacement of its key."""
+        from deequ_tpu import io as dio
+        from deequ_tpu.data import Dataset
+        from deequ_tpu.runners import AnalysisRunner
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        key = ResultKey(BASE_MS, {"env": "prod"})
+        repo.save(key, ctx)  # v1 (Size == 64)
+        monkeypatch.setattr(
+            dio, "remove_file",
+            lambda path: (_ for _ in ()).throw(OSError("readonly")),
+        )
+        repo.compact(month_bucket(BASE_MS))  # v1 merged; loose v1 remains
+        monkeypatch.undo()
+        v2 = AnalysisRunner.do_analysis_run(
+            Dataset.from_dict({"y": [1.0, 2.0]}), [Size()]
+        )
+        monkeypatch.setattr(
+            dio, "remove_file",
+            lambda path: (_ for _ in ()).throw(OSError("readonly")),
+        )
+        repo.save(key, v2)  # prune of stale loose v1 fails too
+        monkeypatch.undo()
+        got = repo.load().get()
+        assert len(got) == 1
+        assert got[0].analyzer_context.metric_map[Size()].value.get() == 2.0
+        repo.compact(month_bucket(BASE_MS))
+        got = repo.load().get()
+        assert len(got) == 1
+        assert got[0].analyzer_context.metric_map[Size()].value.get() == 2.0
+
+    def test_corrupt_loose_entry_self_heals(self, tmp_path, ctx):
+        """A checksum-corrupt LOOSE entry quarantines ONCE (bytes in the
+        sidecar, file dropped) — later reads serve clean instead of
+        re-quarantining forever."""
+        from deequ_tpu.repository.fs import quarantined_total
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        populate(repo, 3, ctx)
+        [entry] = sorted(
+            f for f in os.listdir(tmp_path / "hist" / "2025-01")
+            if f.startswith("e-")
+        )[-1:]
+        path = tmp_path / "hist" / "2025-01" / entry
+        raw = path.read_text()
+        i = raw.index("Mean") + 1
+        path.write_text(raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:])
+        before = quarantined_total()
+        assert len(repo.load().get()) == 2
+        assert quarantined_total() - before == 1
+        assert not path.exists()  # healed
+        assert len(repo.load().get()) == 2
+        assert quarantined_total() - before == 1  # no re-quarantine
+
+    def test_compaction_drops_corrupt_entries(self, tmp_path, ctx):
+        """Compaction is where standing bit rot inside compacted.json
+        self-heals: checksum-corrupt entries quarantine and DROP from the
+        rewrite."""
+        from deequ_tpu.repository.fs import quarantined_total
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        populate(repo, 4, ctx)
+        repo.compact("2025-01")
+        target = tmp_path / "hist" / "2025-01" / "compacted.json"
+        raw = target.read_text()
+        i = raw.index("Mean") + 1
+        target.write_text(raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:])
+        before = quarantined_total()
+        assert repo.compact("2025-01") == 3  # the rotten entry dropped
+        assert quarantined_total() - before == 1
+        # subsequent reads are clean — no per-read re-quarantine
+        assert len(repo.load().get()) == 3
+        assert quarantined_total() - before == 1
+
+    def test_replace_key_across_loose_and_compacted(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=10_000
+        )
+        key = ResultKey(BASE_MS, {"env": "prod"})
+        repo.save(key, ctx)
+        repo.compact("2025-01")
+        repo.save(key, ctx)  # replaces the compacted entry
+        repo.save(key, ctx)  # replaces the loose entry
+        assert len(repo.load().get()) == 1
+        assert repo.load_by_key(key) is not None
+        # distinct tags are distinct keys
+        repo.save(ResultKey(BASE_MS, {"env": "test"}), ctx)
+        assert len(repo.load().get()) == 2
+
+
+class TestQuarantine:
+    def test_flipped_byte_quarantines_one_entry(self, tmp_path, ctx):
+        from deequ_tpu.repository.fs import quarantined_total
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=4
+        )
+        populate(repo, 10, ctx)
+        target = tmp_path / "hist" / "2025-01" / "compacted.json"
+        raw = target.read_text()
+        i = raw.index("Mean") + 1
+        target.write_text(
+            raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+        )
+        before = quarantined_total()
+        got = repo.load().get()
+        assert len(got) == 9  # the flipped entry alone is gone
+        assert quarantined_total() - before == 1
+        side = tmp_path / "hist.quarantine"
+        assert side.is_dir() and list(side.iterdir())
+
+    def test_torn_bucket_serves_rest_and_compaction_refuses(self, tmp_path, ctx):
+        from deequ_tpu.exceptions import CorruptStateError
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=2
+        )
+        populate(repo, 40, ctx)  # jan + feb, both compacted
+        (tmp_path / "hist" / "2025-01" / "compacted.json").write_text(
+            '[{"torn"'
+        )
+        got = repo.load().get()
+        # feb's 9 entries keep serving, plus january's one still-loose
+        # entry — tearing the compacted file costs exactly its payload
+        assert len(got) == 10
+        # saves are APPEND-ONLY (one atomic loose write, the compacted
+        # file untouched) so saving into a torn bucket is safe — it is
+        # COMPACTION that refuses typed (its rewrite would erase whatever
+        # the torn file still holds)
+        with pytest.raises(CorruptStateError):
+            repo.compact("2025-01")
+        repo.save(ResultKey(BASE_MS + 10 * DAY_MS, {"k": "new"}), ctx)
+        assert any(
+            r.result_key.tags_dict.get("k") == "new"
+            for r in repo.load().get()
+        )
+
+    def test_injected_corrupt_fault_takes_the_quarantine_path(self, tmp_path, ctx):
+        from deequ_tpu.reliability import FaultSpec, inject
+        from deequ_tpu.repository.fs import quarantined_total
+
+        repo = PartitionedMetricsRepository(
+            str(tmp_path / "hist"), compact_threshold=2
+        )
+        populate(repo, 6, ctx)
+        before = quarantined_total()
+        with inject(FaultSpec("repository_load", "corrupt", at=1)) as inj:
+            got = repo.load().get()
+        assert inj.fired
+        assert quarantined_total() > before
+        # that read's bucket payload quarantined; the next read recovers
+        assert len(repo.load().get()) == 6
+        assert len(got) < 6
+
+
+class TestLoaderSemantics:
+    def test_filters_match_reference_loader(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        repo.save(ResultKey(BASE_MS, {"env": "prod"}), ctx)
+        repo.save(ResultKey(BASE_MS + DAY_MS, {"env": "test"}), ctx)
+        repo.save(ResultKey(BASE_MS + 2 * DAY_MS, {"env": "prod"}), ctx)
+        assert len(repo.load().get()) == 3
+        assert len(repo.load().with_tag_values({"env": "prod"}).get()) == 2
+        assert len(repo.load().after(BASE_MS + DAY_MS).get()) == 2
+        assert len(repo.load().before(BASE_MS + DAY_MS).get()) == 2
+        only = repo.load().for_analyzers([Size()]).get()
+        assert all(
+            set(r.analyzer_context.metric_map) == {Size()} for r in only
+        )
+
+    def test_records_and_json(self, tmp_path, ctx):
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        repo.save(ResultKey(BASE_MS, {"env": "prod"}), ctx)
+        rows = repo.load().get_success_metrics_as_records(with_tags=["env"])
+        assert rows and all(r["env"] == "prod" for r in rows)
+        json.loads(repo.load().get_success_metrics_as_json())
+
+    def test_survives_reopen(self, tmp_path, ctx):
+        path = str(tmp_path / "hist")
+        PartitionedMetricsRepository(path).save(ResultKey(BASE_MS), ctx)
+        reopened = PartitionedMetricsRepository(path)
+        loaded = reopened.load_by_key(ResultKey(BASE_MS))
+        assert loaded.metric_map[Size()].value.get() == 64.0
+
+
+class TestJvmDialect:
+    def test_gson_history_imports(self, tmp_path, ctx):
+        from deequ_tpu.interop import write_jvm_metrics_history_json
+
+        repo = PartitionedMetricsRepository(str(tmp_path / "hist"))
+        payload = write_jvm_metrics_history_json([
+            AnalysisResult(ResultKey(BASE_MS + d * DAY_MS, {"jvm": "1"}), ctx)
+            for d in range(3)
+        ])
+        assert repo.import_jvm_history(payload) == 3
+        got = repo.load().with_tag_values({"jvm": "1"}).get()
+        assert len(got) == 3
+        # storage is the checksummed NATIVE layout (round-trips verified)
+        assert repo.load_by_key(
+            ResultKey(BASE_MS, {"jvm": "1"})
+        ).metric_map[Size()].value.get() == 64.0
+
+
+class TestLegacyFsWindowedLoad:
+    def test_bounded_query_skips_out_of_window_deserialization(
+        self, tmp_path, ctx
+    ):
+        """THE ISSUE-15 regression pin for the legacy one-file layout: a
+        [after, before]-bounded load deserializes ONLY in-window entries
+        (result-key dates are peeked from the raw dicts first)."""
+        repo = FileSystemMetricsRepository(str(tmp_path / "legacy.json"))
+        for t in range(50):
+            repo.save(ResultKey(t * 1000), ctx)
+        repo.entries_deserialized = 0
+        got = repo.load().after(10_000).before(19_000).get()
+        assert len(got) == 10
+        assert repo.entries_deserialized == 10
+        # an unbounded load still deserializes everything
+        repo.entries_deserialized = 0
+        assert len(repo.load().get()) == 50
+        assert repo.entries_deserialized == 50
+
+    def test_windowed_results_equal_unwindowed_filter(self, tmp_path, ctx):
+        repo = FileSystemMetricsRepository(str(tmp_path / "legacy.json"))
+        for t in range(20):
+            repo.save(ResultKey(t, {"i": str(t)}), ctx)
+        windowed = repo.load().after(5).before(12).get()
+        full = [
+            r for r in repo.load().get()
+            if 5 <= r.result_key.data_set_date <= 12
+        ]
+        assert [r.result_key for r in windowed] == [
+            r.result_key for r in full
+        ]
+
+    def test_unpeekable_entry_still_quarantines(self, tmp_path, ctx):
+        """A structurally-odd entry (no peekable date) must flow through
+        full deserialization so the quarantine path sees it — the window
+        peek must not hide corruption."""
+        from deequ_tpu.repository.fs import quarantined_total
+
+        path = tmp_path / "legacy.json"
+        repo = FileSystemMetricsRepository(str(path))
+        repo.save(ResultKey(1000), ctx)
+        entries = json.loads(path.read_text())
+        entries.append({"garbage": True})
+        path.write_text(json.dumps(entries))
+        before = quarantined_total()
+        got = repo.load().after(500).before(1500).get()
+        assert len(got) == 1
+        assert quarantined_total() - before == 1

@@ -1,0 +1,196 @@
+"""Fleet-watch anomaly-scoring bench: 10k tenants' metric histories,
+serial vs batched, parity-gated (ISSUE 15 acceptance; ROADMAP item 5).
+
+Measures the scoring core the fleet watch runs every harvest: N ragged
+metric series (per-series newest-point search intervals) scored by
+
+- **serial**: one ``strategy.detect`` call per series — the pre-batching
+  per-tenant loop;
+- **batched**: ONE ``strategy.detect_batch`` call over the whole fleet
+  tensor (the ``DEEQU_TPU_FLEETWATCH_BUNDLE`` shape).
+
+Flagged indices AND anomaly messages must match element-for-element
+(``parity`` in the output JSON; the bench stage hard-fails otherwise).
+
+``--window-load`` additionally measures the repository half of the plane:
+a year of daily per-run history written through the legacy one-file
+``FileSystemMetricsRepository`` versus the time-partitioned
+``PartitionedMetricsRepository``, querying one month — wall time and
+entries deserialized per query (the O(all history) -> O(queried window)
+PERF.md table).
+
+Usage::
+
+    python -m tools.anomaly_fleet_bench --series 10000
+    python -m tools.anomaly_fleet_bench --window-load
+
+Emits one JSON line on stdout (the bench stage parses the last line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_fleet(n_series: int, seed: int = 17):
+    """N ragged series shaped like daily metric histories (60-120 points,
+    mild drift + noise), ~1 in 8 with an anomalous newest point."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    fleet = []
+    for i in range(n_series):
+        n = int(rng.integers(60, 120))
+        base = 50.0 + float(rng.normal(0, 10))
+        s = base + 0.02 * np.arange(n) + rng.normal(0, 1.0, n)
+        if i % 8 == 0:
+            s[-1] += float(rng.choice([-1, 1])) * 25.0
+        fleet.append(s.tolist())
+    return fleet
+
+
+def run_scoring(n_series: int, seed: int = 17) -> dict:
+    from deequ_tpu.anomalydetection import OnlineNormalStrategy
+
+    strategy = OnlineNormalStrategy()
+    fleet = build_fleet(n_series, seed)
+    intervals = [(len(s) - 1, len(s)) for s in fleet]
+
+    t0 = time.perf_counter()
+    batched = strategy.detect_batch(fleet, intervals)
+    batched_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    serial = [strategy.detect(s, iv) for s, iv in zip(fleet, intervals)]
+    serial_s = time.perf_counter() - t0
+
+    parity = True
+    for got, want in zip(batched, serial):
+        if [i for i, _ in got] != [i for i, _ in want]:
+            parity = False
+            break
+        for (_, ga), (_, wa) in zip(got, want):
+            if float(ga.value) != float(wa.value) or ga.detail != wa.detail:
+                parity = False
+                break
+    flagged = sum(len(rows) for rows in batched)
+    return {
+        "series": n_series,
+        "points_total": sum(len(s) for s in fleet),
+        "batched_seconds": round(batched_s, 4),
+        "series_per_s": round(n_series / batched_s, 1),
+        "serial_seconds": round(serial_s, 4),
+        "serial_series_per_s": round(n_series / serial_s, 1),
+        "speedup": round(serial_s / batched_s, 2),
+        "detect_calls": 1,
+        "flagged": flagged,
+        "parity": parity,
+    }
+
+
+def run_window_load(days: int = 365, window_days: int = 30) -> dict:
+    """A year of daily history, one-month query: legacy one-file layout
+    vs the time-partitioned buckets (median-of-3 query walls; entry
+    deserialization counts pin the asymptotics)."""
+    import os
+    import shutil
+    import statistics
+    import tempfile
+
+    from deequ_tpu.analyzers import Completeness, Mean, Size
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.repository import (
+        FileSystemMetricsRepository,
+        PartitionedMetricsRepository,
+        ResultKey,
+    )
+    from deequ_tpu.runners import AnalysisRunner
+
+    import numpy as np
+
+    data = Dataset.from_dict(
+        {"x": np.random.default_rng(0).normal(10, 2, 512)}
+    )
+    ctx = AnalysisRunner.do_analysis_run(
+        data, [Size(), Completeness("x"), Mean("x")]
+    )
+    day_ms = 86_400_000
+    base = 1_735_689_600_000  # 2025-01-01T00:00Z
+    root = tempfile.mkdtemp(prefix="anomaly-window-bench-")
+    out = {}
+    try:
+        legacy = FileSystemMetricsRepository(os.path.join(root, "legacy.json"))
+        parted = PartitionedMetricsRepository(os.path.join(root, "parted"))
+        t0 = time.perf_counter()
+        for d in range(days):
+            legacy.save(ResultKey(base + d * day_ms), ctx)
+        out["legacy_populate_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        for d in range(days):
+            parted.save(ResultKey(base + d * day_ms), ctx)
+        out["partitioned_populate_s"] = round(time.perf_counter() - t0, 2)
+
+        lo = base + (days - window_days) * day_ms
+        hi = base + days * day_ms
+
+        def timed(repo):
+            walls = []
+            for _ in range(3):
+                repo.entries_deserialized = 0
+                t = time.perf_counter()
+                got = repo.load().after(lo).before(hi).get()
+                walls.append(time.perf_counter() - t)
+            return statistics.median(walls), len(got), repo.entries_deserialized
+
+        legacy_s, legacy_n, legacy_deser = timed(legacy)
+        parted_s, parted_n, parted_deser = timed(parted)
+        assert legacy_n == parted_n == window_days, (legacy_n, parted_n)
+        # the pre-fix cost model: a windowed query used to deserialize the
+        # WHOLE history and filter afterwards — an unbounded load measures
+        # exactly that work
+        legacy.entries_deserialized = 0
+        t = time.perf_counter()
+        full = legacy.load().get()
+        out["legacy_unwindowed_query_s"] = round(time.perf_counter() - t, 4)
+        out["legacy_unwindowed_entries_deserialized"] = (
+            legacy.entries_deserialized
+        )
+        assert len(full) == days
+        out.update({
+            "days": days,
+            "window_days": window_days,
+            "legacy_query_s": round(legacy_s, 4),
+            "legacy_entries_deserialized": legacy_deser,
+            "partitioned_query_s": round(parted_s, 4),
+            "partitioned_entries_deserialized": parted_deser,
+            "query_speedup": round(legacy_s / parted_s, 2),
+        })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--series", type=int, default=10_000)
+    parser.add_argument("--seed", type=int, default=17)
+    parser.add_argument("--window-load", action="store_true",
+                        help="measure the windowed-history-load half "
+                             "instead of scoring")
+    args = parser.parse_args(argv)
+    if args.window_load:
+        out = run_window_load()
+    else:
+        out = run_scoring(args.series, args.seed)
+    print(json.dumps(out), flush=True)
+    if not out.get("parity", True):
+        print("PARITY MISMATCH serial vs batched scoring", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,9 @@ Tracked per stage:
   — a warm stage recompiling is a regression at ANY throughput;
 - **incremental verification** (ISSUE 13): the +1%-growth point's
   full-scan speedup and reuse ratio (higher-better) and its cost as a
-  fraction of the full scan (lower-better).
+  fraction of the full scan (lower-better);
+- **fleet watch** (ISSUE 15): the batched anomaly-scoring series/s
+  (higher-better).
 
 Substrate guard: scaling numbers measured on the 8-virtual-CPU-device
 fallback model nothing about an accelerator mesh (the r06
@@ -75,6 +77,9 @@ _SCALARS: List[Tuple[str, str, str]] = [
     ("incremental", "incremental_speedup_vs_full", "throughput"),
     ("incremental", "incremental_reuse_ratio", "throughput"),
     ("incremental", "incremental_cost_fraction", "rss"),
+    # fleet watch (ISSUE 15): the per-harvest batched scoring rate must
+    # not rot (higher-better)
+    ("anomaly_fleet", "anomaly_fleet_series_per_s", "throughput"),
 ]
 
 

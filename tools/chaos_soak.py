@@ -172,6 +172,7 @@ def run_soak(
             )
             summary["repo_drill"] = _repository_drill(data, state_root)
             summary["partition_drill"] = _partition_drill(data, state_root)
+            summary["fleetwatch_drill"] = _fleetwatch_drill(data, state_root)
             summary["mesh_drill"] = _mesh_drill(data)
             summary["ingest_drill"] = _ingest_drill(service)
             summary["coalesce_drill"] = _coalesce_drill(service)
@@ -194,6 +195,7 @@ def run_soak(
             summary["succeeded"] + summary["typed_failures"] == jobs,
         "repo_drill": summary["repo_drill"]["ok"],
         "partition_drill": summary["partition_drill"]["ok"],
+        "fleetwatch_drill": summary["fleetwatch_drill"]["ok"],
         "mesh_drill": summary["mesh_drill"]["ok"],
         "ingest_drill": summary["ingest_drill"]["ok"],
         "coalesce_drill": summary["coalesce_drill"]["ok"],
@@ -644,6 +646,113 @@ def _partition_drill(data, tmpdir: str) -> Dict:
             and parity
             and out["stale_reasons"] == ["stale-fingerprint"]
         )
+    return out
+
+
+def _fleetwatch_drill(data, tmpdir: str) -> Dict:
+    """Fleet-watch poisoned-history drill (ISSUE 15): two tenants'
+    partitioned metric histories under a standing watch; after a clean
+    batched harvest, ONE tenant's stored history takes a flipped byte
+    mid-soak. The verdict asserts the poisoned tenant quarantines TYPED
+    (report + export counter), the OTHER tenant's flags are identical to
+    the clean harvest, and the flagged anomaly's trace-correlated flight
+    dump exists and parses. ``inject()`` swaps the soak's ambient plan out
+    so an ambient hit cannot shift the pinned counts."""
+    import glob
+    import json as _json
+    import os
+    import time
+
+    from deequ_tpu.analyzers import Mean, Size
+    from deequ_tpu.metrics import DoubleMetric, Entity, Success
+    from deequ_tpu.reliability import inject
+    from deequ_tpu.repository import PartitionedMetricsRepository, ResultKey
+    from deequ_tpu.runners import AnalysisRunner
+    from deequ_tpu.runners.context import AnalyzerContext
+    from deequ_tpu.service import VerificationService
+
+    out: Dict = {}
+    flight_dir = os.path.join(tmpdir, "fleetwatch-flight")
+    prior_flight = os.environ.get("DEEQU_TPU_FLIGHT_DIR")
+    os.environ["DEEQU_TPU_FLIGHT_DIR"] = flight_dir
+    try:
+        with inject():
+            steady = AnalysisRunner.do_analysis_run(
+                data, [Size(), Mean("x")]
+            )
+            wild = AnalyzerContext({
+                Size(): steady.metric(Size()),
+                Mean("x"): DoubleMetric(
+                    Entity.COLUMN, "Mean", "x", Success(9999.0)
+                ),
+            })
+            now = int(time.time() * 1000)
+            day = 86_400_000
+            repos = {}
+            for tenant in ("drill-flagging", "drill-poisoned"):
+                repo = PartitionedMetricsRepository(
+                    os.path.join(tmpdir, f"fw-{tenant}")
+                )
+                for d in range(20):
+                    repo.save(ResultKey(now - (20 - d) * day), steady)
+                repo.save(
+                    ResultKey(now),
+                    wild if tenant == "drill-flagging" else steady,
+                )
+                repos[tenant] = repo
+            with VerificationService(
+                workers=2, background_warm=False, fleet=False,
+            ) as svc:
+                for tenant, repo in repos.items():
+                    svc.watch_metrics(tenant, repo, [Size(), Mean("x")])
+                clean = svc.fleetwatch.harvest_now()
+                # poison one stored entry of the poisoned tenant: valid
+                # JSON, failing checksum — the bit-rot shape
+                poisoned = repos["drill-poisoned"]
+                entry = sorted(glob.glob(
+                    os.path.join(poisoned.path, "*", "e-*.json")
+                ))[-1]
+                raw = open(entry).read()
+                i = raw.index("Mean") + 1
+                open(entry, "w").write(
+                    raw[:i] + ("X" if raw[i] != "X" else "Y") + raw[i + 1:]
+                )
+                after = svc.fleetwatch.harvest_now()
+                quarantine_counter = svc.metrics.counter_value(
+                    "deequ_service_anomaly_quarantined_total",
+                    tenant="drill-poisoned",
+                )
+        clean_flags = [f for f in clean.flagged if f[0] == "drill-flagging"]
+        after_flags = [f for f in after.flagged if f[0] == "drill-flagging"]
+        dump_ok = False
+        for path in glob.glob(os.path.join(flight_dir, "*.jsonl")):
+            records = [_json.loads(line) for line in open(path)]
+            header = records[0]
+            if any(
+                f.get("kind") == "AnomalyFlagged"
+                for f in header.get("failures", [])
+            ) and header.get("trace_id"):
+                dump_ok = True
+        out.update({
+            "clean_quarantined": list(clean.quarantined_tenants),
+            "after_quarantined": list(after.quarantined_tenants),
+            "clean_flagged": len(clean_flags),
+            "after_flagged": len(after_flags),
+            "quarantine_counter": quarantine_counter,
+            "flight_dump_parses": dump_ok,
+        })
+        out["ok"] = (
+            clean.quarantined_tenants == []
+            and after.quarantined_tenants == ["drill-poisoned"]
+            and quarantine_counter == 1
+            and clean_flags and after_flags == clean_flags
+            and dump_ok
+        )
+    finally:
+        if prior_flight is None:
+            os.environ.pop("DEEQU_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["DEEQU_TPU_FLIGHT_DIR"] = prior_flight
     return out
 
 
