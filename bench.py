@@ -1197,6 +1197,92 @@ def run_anomaly_fleet_stage(n_series: int = 10_000) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# stage 2f: multi-host cluster soak (ISSUE 16 acceptance) — aggregate
+# sessions/s across 1 and 2 real worker PROCESSES routed by the front
+# tier, parity-gated against the closed-form exact-sum oracle
+# ---------------------------------------------------------------------------
+
+
+def run_cluster_soak_stage(
+    procs=(1, 2), sessions: int = 8, batches: int = 8, rows: int = 4096,
+) -> dict:
+    """Cluster tier scale-out (tools/cluster_soak.py): each point spawns N
+    worker processes — whole service planes with their own scheduler and
+    HTTP ingest endpoint — behind the consistent-hash front tier on one
+    shared partition store, and measures aggregate sessions/s. Every point
+    carries the bit-exact parity gate (integer-valued sums are fold-order
+    independent, so the routed cluster must equal the closed-form oracle
+    EXACTLY). Runs DETACHED per point so each cluster starts cold and a
+    point's worker processes can never leak into the next. On one box the
+    processes share cores, so the 2-proc point understates real two-host
+    scaling — the SHAPE (and the ≥1.6x gate tools/bench_diff tracks via
+    cluster_soak_sessions_per_s) is what transfers."""
+    import json as _json
+    import os
+    import subprocess
+
+    t0 = time.perf_counter()
+    points = {}
+    for n in procs:
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tools.cluster_soak", "--stage-json",
+                "--procs", str(n), "--sessions", str(sessions),
+                "--batches", str(batches), "--rows", str(rows),
+            ],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=subprocess_timeout_s(),
+        )
+        if not proc.stdout.strip():
+            raise RuntimeError(
+                f"cluster_soak subprocess rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        point = _json.loads(proc.stdout.strip().splitlines()[-1])
+        if point.get("skipped"):
+            # the environment cannot spawn the worker processes (no free
+            # ports, sandboxed sockets): the stage reports itself skipped
+            # instead of failing the bench
+            log(f"[cluster_soak] skipped: {point.get('reason')}")
+            return {"skipped": True, "reason": point.get("reason")}
+        if point["parity_failures"]:
+            log(
+                f"PARITY MISMATCH cluster soak at {n} procs: "
+                f"{point['parity_failures'][:3]}"
+            )
+            sys.exit(1)
+        points[str(n)] = point
+        log(
+            f"[cluster_soak] {n} proc: "
+            f"{point['sessions_per_s']:.1f} sessions/s "
+            f"({point['folds_per_s']:.0f} folds/s), parity bit-exact"
+        )
+    head = points[str(procs[-1])]
+    base = points[str(procs[0])]
+    scaling = head["sessions_per_s"] / base["sessions_per_s"]
+    log(
+        f"[cluster_soak] headline ({procs[-1]} procs): "
+        f"{head['sessions_per_s']:.1f} sessions/s, "
+        f"{scaling:.2f}x vs {procs[0]} proc"
+    )
+    return {
+        "points": {
+            k: {
+                "sessions_per_s": p["sessions_per_s"],
+                "folds_per_s": p["folds_per_s"],
+                "elapsed_s": p["elapsed_s"],
+            } for k, p in points.items()
+        },
+        "sessions_per_s": head["sessions_per_s"],
+        "scaling_vs_1p": round(scaling, 3),
+        "routes_total": head["counters"][
+            "deequ_service_cluster_routes_total"
+        ],
+        "stage_seconds": time.perf_counter() - t0,
+    }
+
+
+# ---------------------------------------------------------------------------
 # stage 3: incremental/stateful partitions + sketch-state merge (BASELINE
 # config 4: partition states persisted, table metrics refreshed from merged
 # states WITHOUT rescanning data, anomaly check on the history)
@@ -1895,6 +1981,25 @@ def main() -> None:
             "detect_calls": anomaly_fleet["detect_calls"],
             "parity": anomaly_fleet["parity"],
         })
+
+    cluster_soak = staged(
+        "cluster_soak", run_cluster_soak_stage,
+        # two detached points (1-proc, 2-proc), each spawning worker
+        # processes with their own interpreter startup: give the stage
+        # two subprocess budgets, not one in-process stage's
+        budget_s=2 * subprocess_timeout_s() + 30,
+    )
+    if cluster_soak is not None and not cluster_soak.get("skipped"):
+        out["cluster_soak_sessions_per_s"] = cluster_soak["sessions_per_s"]
+        out["cluster_soak_scaling_vs_1p"] = cluster_soak["scaling_vs_1p"]
+        checkpoint("cluster_soak", extra={
+            "points": cluster_soak["points"],
+            "scaling_vs_1p": cluster_soak["scaling_vs_1p"],
+            "routes_total": cluster_soak["routes_total"],
+        })
+    elif cluster_soak is not None:
+        checkpoint("cluster_soak", status="skipped_env",
+                   extra={"reason": cluster_soak.get("reason")})
 
     mesh_scaling = staged(
         "mesh_scaling", run_mesh_scaling_stage,

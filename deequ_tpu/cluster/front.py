@@ -1,0 +1,299 @@
+"""Front tier: consistent-hash session routing, legal migration, and
+host-loss recovery over a set of workers.
+
+The ring (:class:`~deequ_tpu.cluster.ring.HashRing`) is the single
+routing authority: a session key ``tenant/dataset`` always belongs to
+``ring.route(key)``. When membership changes move a key's arc, the
+front tier performs the LEGAL move — sessions migrate only at fold
+boundaries, as flush-on-old / adopt-on-new through the shared partition
+store, carrying the cumulative algebraic states AND the checksummed
+schema contract. Two flavors:
+
+- **graceful** (:meth:`migrate`, triggered by ring changes): the old
+  host flushes + closes first, so the partition store holds everything
+  and nothing needs replaying;
+- **loss** (:meth:`handle_host_loss`, triggered by missed heartbeats or
+  a typed :class:`~deequ_tpu.cluster.membership.HostLossError`): the
+  dead host flushed LAST at some earlier boundary, so the survivor
+  adopts the store's states and the front tier replays its per-session
+  fold journal — every payload accepted since the last flush — into
+  the adopted session. Algebraic states make replay exact: salvage +
+  replay equals the lost session, fold for fold, which is what the
+  chaos drill's parity gate asserts.
+
+Every routing decision, migration, loss and replay bumps a typed
+``deequ_service_cluster_*`` counter (described in
+:func:`~deequ_tpu.cluster.describe_cluster_series`), so the drill can
+PROVE recovery happened rather than infer it from timing.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import trace as _trace
+from .membership import HeartbeatMembership, HostLossError
+from .ring import HashRing
+from .worker import LocalWorker, session_partition
+
+_logger = logging.getLogger(__name__)
+
+
+def _key(tenant: str, dataset: str) -> Tuple[str, str]:
+    return (str(tenant), str(dataset))
+
+
+def _ring_key(key: Tuple[str, str]) -> str:
+    return f"{key[0]}/{key[1]}"
+
+
+class FrontTier:
+    """Routes session traffic to ring-chosen workers; owns migration and
+    recovery. Thread-safe: one re-entrant lock serializes membership
+    changes, placements and journals (ingest forwarding itself happens
+    outside the lock — the target session serializes its own folds)."""
+
+    def __init__(
+        self,
+        metrics=None,
+        membership: Optional[HeartbeatMembership] = None,
+        vnodes: Optional[int] = None,
+    ) -> None:
+        from ..service.metrics import ServiceMetrics
+        from . import describe_cluster_series
+
+        self.ring = HashRing(vnodes=vnodes)
+        self.workers: Dict[str, LocalWorker] = {}
+        self.membership = membership
+        self.metrics = metrics if metrics is not None else ServiceMetrics()
+        describe_cluster_series(self.metrics)
+        self._lock = threading.RLock()
+        #: key -> (checks, session kwargs): what re-creates the session
+        #: anywhere (the schema contract travels via the store, not here)
+        self._specs: Dict[Tuple[str, str], Tuple[tuple, dict]] = {}
+        #: key -> host currently holding the live session
+        self._placements: Dict[Tuple[str, str], str] = {}
+        #: key -> payloads accepted since the last flush — the replay
+        #: log that makes loss recovery exact (cleared at every flush,
+        #: so it holds one fold window, not the session's life)
+        self._journal: Dict[Tuple[str, str], List[Any]] = {}
+
+    # -- membership ------------------------------------------------------
+
+    def add_worker(self, worker: LocalWorker) -> None:
+        """Join ``worker``; keys whose arc re-homes onto it migrate
+        gracefully (flush-on-old / adopt-on-new)."""
+        with self._lock:
+            before = self.ring.snapshot()
+            self.ring.add_host(worker.host_id)
+            self.workers[worker.host_id] = worker
+            worker.start()
+            moved = self.ring.moved_keys(
+                [_ring_key(k) for k in self._placements], before
+            )
+            if moved:
+                self.metrics.inc(
+                    "deequ_service_cluster_ring_moves_total", len(moved)
+                )
+            for key in list(self._placements):
+                if _ring_key(key) in moved:
+                    self._migrate_locked(key, self.ring.route(_ring_key(key)))
+
+    def remove_worker(self, host: str) -> None:
+        """Gracefully drain ``host``: its sessions migrate to the ring's
+        survivors (flush first, nothing replays), then it leaves."""
+        with self._lock:
+            worker = self.workers.get(host)
+            if worker is None:
+                return
+            before = self.ring.snapshot()
+            self.ring.remove_host(host)
+            moved = self.ring.moved_keys(
+                [_ring_key(k) for k in self._placements], before
+            )
+            if moved:
+                self.metrics.inc(
+                    "deequ_service_cluster_ring_moves_total", len(moved)
+                )
+            for key, placed in list(self._placements.items()):
+                if placed == host:
+                    self._migrate_locked(key, self.ring.route(_ring_key(key)))
+            del self.workers[host]
+            worker.close()
+
+    def check_membership(self) -> List[str]:
+        """One health sweep: scan heartbeats, recover every host the TTL
+        (or an injected ``host_loss`` fault) declares dead. Returns the
+        hosts recovered this sweep."""
+        if self.membership is None:
+            return []
+        _alive, lost = self.membership.scan()
+        handled = []
+        for host in lost:
+            if host in self.workers:
+                self.handle_host_loss(host)
+                handled.append(host)
+            self.membership.retire(host)
+        return handled
+
+    # -- session plane ---------------------------------------------------
+
+    def route(self, tenant: str, dataset: str) -> str:
+        """The ring-chosen host for a session key."""
+        host = self.ring.route(_ring_key(_key(tenant, dataset)))
+        self.metrics.inc("deequ_service_cluster_routes_total")
+        return host
+
+    def open_session(
+        self, tenant: str, dataset: str, checks: Sequence[Any] = (), **kw
+    ) -> str:
+        """Create the session on its ring-chosen host; remembers the
+        spec so migration/recovery can re-create it elsewhere. Returns
+        the placed host id."""
+        key = _key(tenant, dataset)
+        with self._lock:
+            host = self.route(tenant, dataset)
+            self._specs[key] = (tuple(checks), dict(kw))
+            self.workers[host].open_session(tenant, dataset, checks, **kw)
+            self._placements[key] = host
+            self._journal.setdefault(key, [])
+            return host
+
+    def ingest(self, tenant: str, dataset: str, data, **kw):
+        """Forward one micro-batch to the session's host (migrating
+        first if the ring re-homed the key) and journal the payload for
+        loss replay."""
+        key = _key(tenant, dataset)
+        with self._lock:
+            if key not in self._placements:
+                raise KeyError(
+                    f"unknown session {tenant}/{dataset}: open it via the "
+                    "front tier first"
+                )
+            owner = self.route(tenant, dataset)
+            if owner != self._placements[key]:
+                self._migrate_locked(key, owner)
+            worker = self.workers[self._placements[key]]
+            self._journal.setdefault(key, []).append(data)
+        return worker.ingest(tenant, dataset, data, **kw)
+
+    def flush(self, tenant: str, dataset: str) -> Optional[str]:
+        """Fold boundary: flush the session's cumulative states (+
+        contract) to the partition store and clear its replay journal —
+        everything journaled is now durably committed."""
+        key = _key(tenant, dataset)
+        with self._lock:
+            host = self._placements.get(key)
+            if host is None:
+                return None
+            name = self.workers[host].flush(tenant, dataset)
+            if name is not None:
+                self._journal[key] = []
+            return name
+
+    def flush_all(self) -> None:
+        with self._lock:
+            for tenant, dataset in list(self._placements):
+                self.flush(tenant, dataset)
+
+    # -- migration + recovery --------------------------------------------
+
+    def _migrate_locked(self, key: Tuple[str, str], new_host: str) -> None:
+        """Graceful move at a fold boundary: release (flush + close) on
+        the old host, adopt from the store on the new one. The flush
+        captures every journaled fold, so the journal clears."""
+        tenant, dataset = key
+        old_host = self._placements.get(key)
+        if old_host == new_host:
+            return
+        checks, kw = self._specs.get(key, ((), {}))
+        with _trace.span(
+            "cluster_migrate", kind="cluster", session=_ring_key(key),
+            source=old_host or "", target=new_host,
+        ):
+            partition = None
+            if old_host is not None and old_host in self.workers:
+                partition = self.workers[old_host].release(tenant, dataset)
+            self.workers[new_host].adopt_session(
+                tenant, dataset, checks,
+                partition=partition or session_partition(tenant), **dict(kw),
+            )
+            self._placements[key] = new_host
+            if partition is not None:
+                self._journal[key] = []
+            self.metrics.inc("deequ_service_cluster_migrations_total")
+
+    def handle_host_loss(self, host: str) -> List[Tuple[str, str]]:
+        """Recover every session placed on a DEAD host: re-hash its ring
+        range to the survivors, adopt each session from its last flushed
+        partition, and replay the journaled folds the flush missed.
+        Returns the recovered keys. Raises
+        :class:`~deequ_tpu.cluster.membership.HostLossError` when no
+        survivor remains to adopt onto."""
+        with self._lock:
+            with _trace.span("cluster_host_loss", kind="cluster", host=host):
+                self.metrics.inc("deequ_service_cluster_host_losses_total")
+                before = self.ring.snapshot()
+                self.ring.remove_host(host)
+                self.workers.pop(host, None)
+                if not self.workers:
+                    raise HostLossError(
+                        host, site="cluster_front",
+                        detail="no surviving workers to recover onto",
+                    )
+                moved = self.ring.moved_keys(
+                    [_ring_key(k) for k in self._placements], before
+                )
+                if moved:
+                    self.metrics.inc(
+                        "deequ_service_cluster_ring_moves_total", len(moved)
+                    )
+                recovered = []
+                for key, placed in list(self._placements.items()):
+                    if placed != host:
+                        continue
+                    tenant, dataset = key
+                    new_host = self.ring.route(_ring_key(key))
+                    checks, kw = self._specs.get(key, ((), {}))
+                    # adopt the LAST FLUSHED states (+ contract) from the
+                    # shared store — the dead host cannot flush again, so
+                    # no fold can double-commit...
+                    self.workers[new_host].adopt_session(
+                        tenant, dataset, checks, **dict(kw)
+                    )
+                    # ...and replay the journal — every payload accepted
+                    # since that flush — so no fold is lost either
+                    replayed = 0
+                    for payload in self._journal.get(key, []):
+                        self.workers[new_host].ingest(
+                            tenant, dataset, payload
+                        )
+                        replayed += 1
+                    self._placements[key] = new_host
+                    self.metrics.inc(
+                        "deequ_service_cluster_sessions_recovered_total"
+                    )
+                    if replayed:
+                        self.metrics.inc(
+                            "deequ_service_cluster_replayed_folds_total",
+                            replayed,
+                        )
+                    _trace.add_event(
+                        "cluster_session_recovered", session=_ring_key(key),
+                        source=host, target=new_host, replayed=replayed,
+                    )
+                    recovered.append(key)
+                if self.membership is not None:
+                    self.membership.retire(host)
+                return recovered
+
+    def placement(self, tenant: str, dataset: str) -> Optional[str]:
+        return self._placements.get(_key(tenant, dataset))
+
+    def close(self) -> None:
+        with self._lock:
+            for worker in self.workers.values():
+                worker.close()
+            self.workers.clear()

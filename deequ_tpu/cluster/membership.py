@@ -1,0 +1,211 @@
+"""Membership + health: who is in the cluster, and who just died.
+
+Workers prove liveness by heartbeating a shared directory: each writes
+``host-<id>.json`` (atomic tmp + rename — readers never see a torn
+beat) carrying its host id, a monotonically increasing beat sequence,
+and a wall-clock stamp. The front tier scans the directory: a host
+whose last beat is older than ``DEEQU_TPU_CLUSTER_HOST_TTL_S`` is
+declared LOST and surfaces as a typed :class:`HostLossError` — the
+signal that drives ring re-hash + session recovery in
+:class:`~deequ_tpu.cluster.front.FrontTier`. Files, not sockets,
+deliberately: the partition store and the compaction lease already live
+on the shared filesystem, so membership rides the same substrate with
+the same failure domain (a worker that cannot reach the share cannot
+beat — and also cannot commit, so declaring it lost is safe).
+
+Each membership scan passes a ``host_heartbeat`` fault probe per host
+(tag = host id), so chaos plans can declare an arbitrary host dead
+without killing anything.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import ShardLossError
+from ..reliability.faults import fault_point
+from ..utils import env_number
+
+_logger = logging.getLogger(__name__)
+
+#: seconds between heartbeat writes from a live worker
+HEARTBEAT_ENV = "DEEQU_TPU_CLUSTER_HEARTBEAT_S"
+DEFAULT_HEARTBEAT_S = 0.5
+
+#: seconds without a beat before a host is declared lost (should be a
+#: few multiples of the heartbeat period to ride out scheduler hiccups)
+HOST_TTL_ENV = "DEEQU_TPU_CLUSTER_HOST_TTL_S"
+DEFAULT_HOST_TTL_S = 3.0
+
+
+def heartbeat_s() -> float:
+    return float(
+        env_number(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S, float, minimum=0.05)
+    )
+
+
+def host_ttl_s() -> float:
+    return float(
+        env_number(HOST_TTL_ENV, DEFAULT_HOST_TTL_S, float, minimum=0.1)
+    )
+
+
+class HostLossError(ShardLossError):
+    """A cluster WORKER HOST died (missed heartbeats past the TTL, or a
+    cross-host collective hung past its deadline). The cluster-tier
+    sibling of a mesh shard loss — and deliberately a
+    :class:`~deequ_tpu.exceptions.ShardLossError` subclass so anything
+    routing on the mesh-recoverable family treats it identically — but
+    it names a HOST ID, not mesh positions: recovery is the front
+    tier's job (re-hash the ring range to survivors, re-open the dead
+    host's sessions from the partition store, replay unflushed folds),
+    not the elastic mesh ladder's."""
+
+    def __init__(self, host: str, site: str = "", detail: str = "",
+                 survivors=None):
+        self.host = str(host)
+        self.lost = ()
+        self.site = site
+        self.survivors = None if survivors is None else list(survivors)
+        # bypass ShardLossError's shard-index message: a host loss names
+        # a host id, and "shard(s) [] lost" would read as a no-op
+        Exception.__init__(
+            self,
+            f"cluster host {self.host or '<host>'} lost"
+            + (f" at {site}" if site else "")
+            + (f": {detail}" if detail else ""),
+        )
+
+
+class HeartbeatMembership:
+    """File-based heartbeat membership over a shared directory.
+
+    One instance per participant: workers call :meth:`beat` (or run
+    :meth:`start` for a background beater), the front tier calls
+    :meth:`scan` to partition the membership into (alive, lost). A
+    lost host's beat file is retired by whoever recovers it
+    (:meth:`retire`), so one loss is reported once."""
+
+    def __init__(
+        self,
+        root: str,
+        host_id: str = "",
+        heartbeat_period_s: Optional[float] = None,
+        ttl_s: Optional[float] = None,
+    ) -> None:
+        self.root = root
+        self.host_id = host_id
+        self.period_s = (
+            heartbeat_s() if heartbeat_period_s is None
+            else float(heartbeat_period_s)
+        )
+        self.ttl_s = host_ttl_s() if ttl_s is None else float(ttl_s)
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, host: str) -> str:
+        safe = "".join(
+            c if c.isalnum() or c in "-_." else "_" for c in host
+        )
+        return os.path.join(self.root, f"host-{safe}.json")
+
+    # -- writer side (workers) -------------------------------------------
+
+    def beat(self) -> None:
+        """Write one heartbeat (atomic rename; readers never see torn
+        JSON). Failures log-and-continue: a missed beat is exactly the
+        condition the TTL already tolerates."""
+        if not self.host_id:
+            raise ValueError("beat() requires a host_id")
+        self._seq += 1
+        payload = json.dumps(
+            {"host": self.host_id, "seq": self._seq, "ts": time.time()}
+        )
+        path = self._path(self.host_id)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+        except OSError as exc:  # pragma: no cover - fs-dependent
+            _logger.warning("heartbeat write failed for %s: %s",
+                            self.host_id, exc)
+
+    def start(self) -> None:
+        """Background beater at ``period_s`` until :meth:`stop`."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.period_s):
+                self.beat()
+
+        self.beat()
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name=f"heartbeat-{self.host_id}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=self.period_s * 4)
+        self._thread = None
+
+    # -- reader side (front tier) ----------------------------------------
+
+    def members(self) -> Dict[str, dict]:
+        """Last beat per host (torn/alien files skipped)."""
+        out: Dict[str, dict] = {}
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("host-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.root, name), encoding="utf-8") as fh:
+                    rec = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            host = rec.get("host")
+            if isinstance(host, str) and host:
+                out[host] = rec
+        return out
+
+    def scan(self) -> Tuple[List[str], List[str]]:
+        """Partition the membership into ``(alive, lost)`` by beat age.
+        Each host passes a ``host_heartbeat`` fault probe (tag = host
+        id); an injected ``host_loss`` fault declares that host dead —
+        the chaos path that exercises recovery without killing a
+        process."""
+        now = time.time()
+        alive: List[str] = []
+        lost: List[str] = []
+        for host, rec in sorted(self.members().items()):
+            try:
+                fault_point("host_heartbeat", tag=host)
+            except HostLossError:
+                lost.append(host)
+                continue
+            age = now - float(rec.get("ts", 0.0))
+            (alive if age <= self.ttl_s else lost).append(host)
+        return alive, lost
+
+    def retire(self, host: str) -> None:
+        """Drop ``host``'s beat file after its loss has been handled, so
+        subsequent scans stop reporting it."""
+        try:
+            os.unlink(self._path(host))
+        except OSError:
+            pass

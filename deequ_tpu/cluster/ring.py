@@ -1,0 +1,149 @@
+"""Consistent-hash ring: the front tier's session router.
+
+Sessions are sticky — a streaming session's algebraic states live on ONE
+worker between fold boundaries — so routing must be a pure function of
+the session key that (a) every front-tier replica computes identically
+and (b) moves as FEW keys as possible when the host set changes. A
+consistent-hash ring with virtual nodes gives both: each host owns
+``DEEQU_TPU_CLUSTER_VNODES`` pseudo-random points on a 64-bit circle,
+and a key routes to the first point clockwise of its own hash. Adding or
+removing one host re-homes only the ~1/N of keys whose clockwise arc
+changed; everything else stays put (sessions legally move hosts only at
+fold boundaries, via flush-on-A / re-open-on-B through the partition
+store — the ring decides WHERE, :class:`~deequ_tpu.cluster.front
+.FrontTier` performs the move).
+
+Hashing is ``blake2b`` (stdlib, keyed by nothing, stable across
+processes and Python runs — ``hash()`` is salted per process and
+useless here). Ring mutations pass a ``ring_rebalance`` fault probe so
+chaos plans can fail the re-hash mid-membership-change.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..reliability.faults import fault_point
+from ..utils import env_number
+
+#: virtual nodes per host — more points = smoother key distribution at
+#: slightly larger rings; 64 keeps the worst-case host imbalance under a
+#: few percent for small clusters
+VNODES_ENV = "DEEQU_TPU_CLUSTER_VNODES"
+DEFAULT_VNODES = 64
+
+
+def ring_vnodes() -> int:
+    return int(
+        env_number(VNODES_ENV, DEFAULT_VNODES, int, minimum=1)
+    )
+
+
+def stable_hash(key: str) -> int:
+    """Process-stable 64-bit hash of ``key`` (blake2b, first 8 bytes)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(),
+        "big",
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Not thread-safe by itself — the front tier serializes membership
+    changes under its own lock; lookups between mutations are reads of
+    immutable snapshots (``_points``/``_owners`` are rebuilt wholesale,
+    never edited in place, so a racing ``route`` sees either the old or
+    the new ring, both valid)."""
+
+    def __init__(
+        self,
+        hosts: Sequence[str] = (),
+        vnodes: Optional[int] = None,
+    ) -> None:
+        self._vnodes = ring_vnodes() if vnodes is None else max(1, int(vnodes))
+        self._hosts: List[str] = []
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        for host in hosts:
+            self.add_host(host)
+
+    @property
+    def hosts(self) -> Tuple[str, ...]:
+        return tuple(self._hosts)
+
+    @property
+    def vnodes(self) -> int:
+        return self._vnodes
+
+    def __len__(self) -> int:
+        return len(self._hosts)
+
+    def __contains__(self, host: str) -> bool:
+        return host in self._hosts
+
+    def _rebuild(self) -> None:
+        pairs: List[Tuple[int, str]] = []
+        for host in self._hosts:
+            for v in range(self._vnodes):
+                pairs.append((stable_hash(f"{host}#{v}"), host))
+        # ties broken by host id so every replica builds the same ring
+        pairs.sort(key=lambda p: (p[0], p[1]))
+        self._points = [p[0] for p in pairs]
+        self._owners = [p[1] for p in pairs]
+
+    def add_host(self, host: str) -> None:
+        """Add ``host``; ~1/N of key space re-homes onto it."""
+        if host in self._hosts:
+            return
+        fault_point("ring_rebalance", tag=host)
+        self._hosts.append(host)
+        self._hosts.sort()
+        self._rebuild()
+
+    def remove_host(self, host: str) -> None:
+        """Remove ``host``; its arcs re-home to the clockwise survivors."""
+        if host not in self._hosts:
+            return
+        fault_point("ring_rebalance", tag=host)
+        self._hosts.remove(host)
+        self._rebuild()
+
+    def route(self, key: str) -> str:
+        """Owner host for ``key``: first ring point clockwise of its hash.
+
+        Raises ``LookupError`` on an empty ring — the caller (front tier)
+        decides whether that is a 503 or a crash."""
+        if not self._points:
+            raise LookupError("hash ring has no hosts")
+        idx = bisect.bisect_right(self._points, stable_hash(key))
+        if idx == len(self._points):
+            idx = 0
+        return self._owners[idx]
+
+    def moved_keys(
+        self, keys: Sequence[str], before: "HashRing"
+    ) -> Dict[str, Tuple[str, str]]:
+        """Which of ``keys`` route differently on this ring vs ``before``:
+        ``{key: (old_host, new_host)}`` — the migration work-list for a
+        membership change (everything absent stayed put)."""
+        moved: Dict[str, Tuple[str, str]] = {}
+        for key in keys:
+            try:
+                old = before.route(key)
+            except LookupError:
+                old = ""
+            new = self.route(key)
+            if old != new:
+                moved[key] = (old, new)
+        return moved
+
+    def snapshot(self) -> "HashRing":
+        """Independent copy (for ``moved_keys`` before/after diffs)."""
+        clone = HashRing(vnodes=self._vnodes)
+        clone._hosts = list(self._hosts)
+        clone._points = list(self._points)
+        clone._owners = list(self._owners)
+        return clone

@@ -1,0 +1,158 @@
+"""One cluster worker: a :class:`~deequ_tpu.service.VerificationService`
+plus the cluster-facing session protocol the front tier drives.
+
+A worker is a whole single-host service plane — its own FleetScheduler,
+coalescer, placement router, metrics exporter and (optionally) HTTP
+ingest endpoint — made clusterable by three capabilities layered here:
+
+- **open/ingest/flush** against sessions addressed by (tenant, dataset)
+  — what the front tier routes to the ring-chosen host;
+- **release**: flush the session's cumulative algebraic states (and its
+  checksummed schema contract) into the SHARED partition store, then
+  close it — the first half of a legal migration (sessions move hosts
+  only at fold boundaries);
+- **adopt**: re-open a session AGAINST the flushed partition's state
+  provider, so the new host resumes from the exact cumulative states +
+  contract the old host committed — the second half of a migration, and
+  the recovery path after a host loss (salvage from the store, then the
+  front tier replays the folds the flush missed).
+
+Workers also heartbeat the shared membership directory so the front
+tier can tell a live host from a dead one.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Sequence
+
+from .membership import HeartbeatMembership
+
+_logger = logging.getLogger(__name__)
+
+
+def session_partition(tenant: str) -> str:
+    """The partition a (tenant, dataset) session flushes into — must
+    match ``StreamingSession._flush_to_partition_locked``'s default so
+    adoption reads exactly what release wrote."""
+    return f"session-{tenant}"
+
+
+class LocalWorker:
+    """In-process worker: wraps a service the front tier can route to.
+
+    The same protocol an HTTP-fronted worker speaks (tools/cluster_soak
+    drives remote workers through the ingest endpoint); in-process it is
+    plain method calls, which is what unit tests and the chaos drills
+    compose."""
+
+    def __init__(
+        self,
+        host_id: str,
+        service,
+        membership: Optional[HeartbeatMembership] = None,
+    ) -> None:
+        self.host_id = str(host_id)
+        self.service = service
+        self.membership = membership
+        if membership is not None and not membership.host_id:
+            membership.host_id = self.host_id
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self.membership is not None:
+            self.membership.start()
+
+    def close(self, **kw) -> None:
+        if self.membership is not None:
+            self.membership.stop()
+        self.service.close(**kw)
+
+    # -- session protocol ------------------------------------------------
+
+    def open_session(
+        self, tenant: str, dataset: str, checks: Sequence[Any] = (), **kw
+    ):
+        return self.service.session(tenant, dataset, checks, **kw)
+
+    def ingest(self, tenant: str, dataset: str, data, **kw):
+        session = self.service.get_session(tenant, dataset)
+        if session is None:
+            raise KeyError(
+                f"no live session {tenant}/{dataset} on host {self.host_id}"
+            )
+        return session.ingest(data, **kw)
+
+    def flush(
+        self, tenant: str, dataset: str, partition: Optional[str] = None
+    ) -> Optional[str]:
+        """Flush the session's cumulative states + contract into the
+        shared partition store (fold boundary). Returns the partition
+        name, or None when the session never folded."""
+        session = self.service.get_session(tenant, dataset)
+        if session is None:
+            return None
+        return session.flush_to_partition(partition=partition)
+
+    def release(self, tenant: str, dataset: str) -> Optional[str]:
+        """Flush then CLOSE the session — the outbound half of a
+        migration. After release the states live in the partition store
+        and this host serves 410 for the session."""
+        session = self.service.get_session(tenant, dataset)
+        if session is None:
+            return None
+        name = session.flush_to_partition()
+        session.close()
+        return name
+
+    def adopt_session(
+        self,
+        tenant: str,
+        dataset: str,
+        checks: Sequence[Any] = (),
+        partition: Optional[str] = None,
+        **kw,
+    ):
+        """Re-open a migrated/lost session from the shared partition
+        store: the new session's state provider IS the flushed
+        partition's provider, so it resumes from the committed
+        cumulative states and re-loads the checksummed schema contract
+        beside them (drift policies fire identically post-migration).
+        A session that never flushed adopts an EMPTY provider — correct,
+        because the front tier then replays every journaled fold."""
+        store = getattr(self.service, "partition_store", None)
+        if store is None:
+            raise ValueError(
+                f"host {self.host_id} has no partition store to adopt from"
+            )
+        name = partition or session_partition(tenant)
+        kw.setdefault("state_provider", store.provider(dataset, name))
+        session = self.service.session(tenant, dataset, checks, **kw)
+        if session._schema is None:
+            manifest = store.get(dataset, name)
+            if manifest is not None and manifest.schema:
+                from ..data import ColumnKind, ColumnSchema, Schema
+
+                # the flushed manifest carries the schema the states were
+                # folded under: restoring it lets the adopted session
+                # serve state-only queries (current()) BEFORE its first
+                # post-adoption fold, and keeps the committed row total
+                # cumulative across the migration
+                session._schema = Schema([
+                    ColumnSchema(n, ColumnKind(k))
+                    for n, k in manifest.schema
+                ])
+                session.rows_ingested = int(manifest.num_rows)
+        return session
+
+    def session_stats(self, tenant: str, dataset: str) -> dict:
+        session = self.service.get_session(tenant, dataset)
+        if session is None:
+            return {}
+        return {
+            "host": self.host_id,
+            "batches": session.batches_ingested,
+            "rows": session.rows_ingested,
+            "bytes": session.bytes_ingested,
+        }

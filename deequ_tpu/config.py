@@ -331,3 +331,38 @@ from .analyzers.grouping import (  # noqa: E402,F401
     FREQ_HOST_ROUTE_ENV,
     FREQ_TABLE_SLOTS_ENV,
 )
+
+# ---------------------------------------------------------------------------
+# Cluster tier (implemented in deequ_tpu.cluster + repository/lease.py; the
+# env knobs are documented here with the other operator-facing switches)
+# ---------------------------------------------------------------------------
+#
+# - DEEQU_TPU_CLUSTER_VNODES: virtual nodes per host on the front tier's
+#   consistent-hash ring (default 64; minimum 1). More points smooth the
+#   per-host key distribution at slightly larger ring rebuild cost; a
+#   membership change always re-homes only ~1/N of the key space.
+# - DEEQU_TPU_CLUSTER_HEARTBEAT_S: seconds between a worker's heartbeat
+#   writes into the shared membership directory (default 0.5; minimum
+#   0.05). Heartbeats are atomic tmp+rename file writes on the same
+#   shared filesystem the partition store uses.
+# - DEEQU_TPU_CLUSTER_HOST_TTL_S: seconds without a beat before the front
+#   tier declares a host LOST (default 3.0; minimum 0.1) and runs
+#   recovery: ring re-hash to survivors, session adoption from the
+#   partition store, journal replay of the folds the last flush missed.
+#   Size it to several heartbeat periods to ride out scheduler hiccups.
+# - DEEQU_TPU_CLUSTER_LEASE_TTL_S: seconds a compaction lease on a
+#   PartitionedMetricsRepository stays valid without renewal (default
+#   30.0; minimum 0.1). The lease elects ONE compactor among concurrent
+#   writers (atomic create + epoch-fenced takeover of stale holders); a
+#   refused or lost lease leaves loose entries readable — never deleted.
+#
+# All four parse via the shared warn-once utils.env_* readers:
+# unparseable or out-of-range values log once and keep the default.
+from .cluster.membership import (  # noqa: E402,F401
+    HEARTBEAT_ENV as CLUSTER_HEARTBEAT_ENV,
+    HOST_TTL_ENV as CLUSTER_HOST_TTL_ENV,
+)
+from .cluster.ring import VNODES_ENV as CLUSTER_VNODES_ENV  # noqa: E402,F401
+from .repository.lease import (  # noqa: E402,F401
+    LEASE_TTL_ENV as CLUSTER_LEASE_TTL_ENV,
+)

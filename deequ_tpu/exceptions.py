@@ -261,6 +261,7 @@ _SUBSYSTEM_EXCEPTIONS = {
     "ExpressionError": "deequ_tpu.expr",
     "FrequencyBudgetExceeded": "deequ_tpu.analyzers.grouping",
     "MeshExhaustedError": "deequ_tpu.parallel.elastic",
+    "HostLossError": "deequ_tpu.cluster.membership",
 }
 
 

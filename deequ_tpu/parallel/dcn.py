@@ -1,0 +1,239 @@
+"""DCN (cross-host) fold/merge library: the multi-process leg of the
+parallel tier, promoted from ``tools/dcn_smoke.py`` into library code the
+cluster tier composes (ROADMAP item 2).
+
+One machine or many, the shape is the same: N OS processes, each owning
+its local chips, ``jax.distributed.initialize``d into ONE global mesh
+whose row axis spans processes — every collective then crosses the
+process boundary (on one box over the gloo CPU backend, the DCN
+stand-in; on real pods over the actual DCN). On that mesh the ordinary
+``sharded_ingest_fold`` + ``collective_merge_states`` run unchanged, so
+cross-host battery aggregation is the SAME butterfly merge the
+single-host fleet uses, just with network legs.
+
+What lives here:
+
+- process bring-up (:func:`initialize_dcn`, :func:`dcn_worker_env`) — the
+  gloo + one-device-per-process env plumbing every multi-process test and
+  tool used to copy-paste;
+- the host-partial helpers (:func:`host_partials`, :func:`stack_partials`)
+  feeding the mesh fold;
+- :func:`merge_host_states`: each process contributes its HOST-side
+  aggregate state as its shard of a global stacked array, and one
+  log2(n) butterfly merge returns the cluster-wide battery state — the
+  cluster tier's cross-host aggregation primitive (a coalescer drains
+  per-host first; only the drained per-host aggregates ride the DCN);
+- the loss-tolerant wrappers (:func:`with_deadline`,
+  :func:`salvage_local_states`, :func:`replay_partials`): a dead peer
+  makes the next cross-process step fail or hang, so every DCN dispatch
+  runs under a deadline; on loss the survivor salvages its OWN
+  addressable shard (algebraic states are mergeable by construction) and
+  replays what the dead shard owned with eager host-side semigroup folds
+  — no collectives, the mesh is gone.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import (
+    collective_merge_states,
+    make_mesh,
+    sharded_ingest_fold,
+)
+
+#: default seconds a cross-process fold/merge may take before the peer is
+#: declared lost (the drills' bar; operators size it to their DCN)
+DEFAULT_DCN_DEADLINE_S = 15.0
+
+
+def dcn_worker_env(base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Environment for a spawned DCN worker process: CPU platform with ONE
+    device per process, so the mesh axis SPANS processes and every
+    collective crosses the process boundary — the DCN path."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def initialize_dcn(
+    coordinator_address: str, num_processes: int, process_id: int
+) -> None:
+    """Join this process into the global mesh: gloo cross-process CPU
+    collectives + ``jax.distributed.initialize``. Idempotent per process
+    (re-initialize raises inside jax; callers spawn fresh processes)."""
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def with_deadline(fn, seconds: float):
+    """Run ``fn`` on a daemon thread with a deadline; returns ``(value,
+    error, timed_out)``. The DCN loss detector: a dead peer makes a
+    cross-process step either raise or hang — the deadline converts the
+    hang into a detectable loss signal without wedging the survivor."""
+    box: dict = {}
+    done = threading.Event()
+
+    def body():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 - reported to caller
+            box["error"] = exc
+        finally:
+            done.set()
+
+    threading.Thread(target=body, daemon=True).start()
+    timed_out = not done.wait(seconds)
+    return box.get("value"), box.get("error"), timed_out
+
+
+def host_partials(
+    analyzers: Sequence[Any], data, batch_rows: int
+) -> List[Tuple]:
+    """Per-batch host partial tuples of ``data`` (the mesh fold's input
+    currency): one tuple of per-analyzer partial states per batch."""
+    from ..analyzers.base import HostBatchContext
+
+    partials = []
+    for index, batch in enumerate(
+        data.batches(batch_rows, pad_to_batch_size=False)
+    ):
+        ctx = HostBatchContext(batch, batch_index=index)
+        partials.append(tuple(a.host_partial(ctx) for a in analyzers))
+    return partials
+
+
+def stack_partials(analyzers: Sequence[Any], partials: Sequence[Tuple]):
+    """Stack per-batch partial tuples along a leading batch axis, one
+    stacked pytree per analyzer (what ``sharded_ingest_fold`` scans)."""
+    return tuple(
+        jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[p[i] for p in partials],
+        )
+        for i in range(len(analyzers))
+    )
+
+
+def fold_partials(
+    analyzers: Sequence[Any], mesh, states, partials: Sequence[Tuple]
+):
+    """Fold a chunk of host partials over the (possibly cross-process)
+    mesh; blocks until the dispatch completes so a dead peer surfaces
+    here, not at an arbitrary later sync point."""
+    flags = np.ones(len(partials), dtype=bool)
+    out = sharded_ingest_fold(
+        analyzers, mesh, states, stack_partials(analyzers, partials), flags
+    )
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    return out
+
+
+def salvage_local_states(states) -> Tuple:
+    """This process's addressable shard of per-device stacked states —
+    the surviving state after a peer died (the peer's shard died with the
+    peer). Works on global (multi-process) and local arrays alike."""
+
+    def local_shard(tree):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x.addressable_data(0))[0]
+            if isinstance(x, jax.Array) and not x.is_fully_addressable
+            else np.asarray(x[0]),
+            tree,
+        )
+
+    return tuple(local_shard(tree) for tree in states)
+
+
+def replay_partials(
+    analyzers: Sequence[Any],
+    salvaged: Sequence[Any],
+    partials: Sequence[Tuple],
+    indices: Sequence[int],
+) -> Tuple:
+    """Replay the batch slices a dead shard owned into the salvaged
+    states: eager host-side semigroup folds (``ingest_partial``), no
+    collectives — the mesh is gone. Algebraic states make this exact,
+    not approximate: replay + salvage equals the lost fold."""
+    finished = []
+    for i, a in enumerate(analyzers):
+        acc = salvaged[i]
+        for j in indices:
+            acc = a.ingest_partial(acc, partials[j][i])
+        finished.append(acc)
+    return tuple(finished)
+
+
+def merge_host_states(
+    analyzers: Sequence[Any],
+    local_states: Sequence[Any],
+    mesh=None,
+    deadline_s: float = DEFAULT_DCN_DEADLINE_S,
+):
+    """Cross-host battery aggregation: every process contributes its
+    HOST-side aggregate states (one per analyzer — e.g. a worker's
+    drained per-host session aggregate) as its own shard of a global
+    stacked array, then ONE log2(n) butterfly merge
+    (``collective_merge_states``) returns the cluster-wide state to every
+    process. Runs under ``deadline_s``; returns ``(merged_states, None)``
+    on success or ``(None, reason)`` when a peer failed/hung — the caller
+    salvages via the partition store instead.
+
+    Single-process: the identity (the local states ARE the aggregate)."""
+    if jax.process_count() == 1:
+        return (
+            tuple(
+                jax.tree_util.tree_map(np.asarray, s) for s in local_states
+            ),
+            None,
+        )
+    mesh = mesh if mesh is not None else make_mesh()
+    n_dev = int(mesh.devices.size)
+    pid = int(jax.process_index())
+
+    # per-shard stack: row pid carries THIS process's aggregate, every
+    # other row the identity state. collective_merge_states lays the rows
+    # out over the mesh axis via make_array_from_callback, under which
+    # each process materializes only its own addressable row — so row i
+    # of the GLOBAL array is process i's aggregate, and the identity
+    # rows here are placement filler that is never read cross-process
+    # (and merge-transparent even if a backend materializes them).
+    def stacked_for(a, state):
+        ident = a.init_state()
+
+        def leaf(x, i):
+            arr = np.asarray(x)
+            base = np.asarray(i).astype(arr.dtype)
+            out = np.broadcast_to(base[None], (n_dev,) + arr.shape).copy()
+            out[pid] = arr
+            return out
+
+        return jax.tree_util.tree_map(leaf, state, ident)
+
+    stacked = tuple(
+        stacked_for(a, s) for a, s in zip(analyzers, local_states)
+    )
+
+    def run():
+        merged = collective_merge_states(analyzers, mesh, stacked)
+        jax.block_until_ready(jax.tree_util.tree_leaves(merged))
+        return merged
+
+    merged, err, timed_out = with_deadline(run, deadline_s)
+    if merged is not None:
+        return merged, None
+    reason = (
+        "collective merge timed out" if timed_out
+        else f"collective merge failed: {err}"
+    )
+    return None, reason

@@ -42,6 +42,9 @@ site                 fires
 ``shard_probe``      per mesh shard in the heartbeat health probe, tag = shard
 ``frame_decode``     per ingest-plane frame before it folds, tag = frame idx
 ``prefetch``         per staged batch in the device feed pipeline, tag = idx
+``host_heartbeat``   per host in the cluster membership scan, tag = host id
+``ring_rebalance``   before a hash-ring host add/remove re-hashes key ranges
+``lease_acquire``    at a compaction-lease election attempt, tag = lease path
 ===================  ========================================================
 
 The ``corrupt`` kind (a typed ``CorruptStateError``) injected at the three
@@ -63,6 +66,11 @@ it exercises the elastic salvage + re-shard path, at ``shard_probe`` it
 makes the heartbeat declare that shard dead; ``shard_stall`` (a typed
 ``ShardStallError``, same payload) stands in for a shard that wedged
 without raising and was declared lost by the heartbeat deadline.
+
+The cluster kind: ``host_loss`` (a typed ``HostLossError`` whose ``host``
+carries the probe tag) stands in for a whole worker PROCESS dying —
+injected at ``host_heartbeat`` it makes the membership scan declare that
+host dead and the front tier re-hash its ring range to survivors.
 """
 
 from __future__ import annotations
@@ -147,13 +155,17 @@ def _make_error(
         from ..exceptions import ShardStallError
 
         return ShardStallError([0 if shard is None else shard], site, detail=note)
+    if kind == "host_loss":
+        from ..cluster.membership import HostLossError
+
+        return HostLossError(tag or site, site=site, detail=note)
     raise ValueError(f"unknown fault kind {kind!r}")
 
 
 FAULT_KINDS = (
     "device", "oom", "poison", "analyzer", "interrupt", "worker_death",
     "stall", "corrupt", "drift", "mesh_loss", "shard_stall",
-    "frame_corrupt", "feed_stall",
+    "frame_corrupt", "feed_stall", "host_loss",
 )
 
 #: The fault-site REGISTRY: every ``fault_point(site, ...)`` planted in the
@@ -182,6 +194,9 @@ KNOWN_FAULT_SITES = frozenset({
     "shard_probe",
     "frame_decode",
     "prefetch",
+    "host_heartbeat",
+    "ring_rebalance",
+    "lease_acquire",
 })
 
 

@@ -94,10 +94,19 @@ class PartitionedMetricsRepository(MetricsRepository):
         #: bucket could otherwise each rewrite compacted.json wholesale
         #: and the loser's rewrite would drop entries the winner merged
         #: (and whose loose files the winner already removed). In-process
-        #: only — like the reference's one-file repository, cross-PROCESS
-        #: writers of one store root need external coordination; reads
-        #: and append-only saves are safe throughout.
+        #: half of the story; the CROSS-process half is the lease below.
         self._compact_lock = threading.Lock()
+        #: cross-process single-compactor election (repository.lease): a
+        #: filesystem lease/fence file beside the root. Only local roots
+        #: get one (the link/rename primitives are POSIX); remote roots
+        #: keep the documented in-process-only guarantee. Reads and
+        #: append-only saves never touch the lease — they are safe against
+        #: concurrent compactors by the append-first commit protocol.
+        self.lease = None
+        if dio.is_local(self.path):
+            from .lease import FileLease
+
+            self.lease = FileLease(self.path + ".lease")
         dio.makedirs(self.path)
 
     # -- layout --------------------------------------------------------------
@@ -247,14 +256,27 @@ class PartitionedMetricsRepository(MetricsRepository):
     def compact(self, bucket: str) -> int:
         """Merge a bucket's loose entry files into its single
         ``compacted.json`` (recency-stamped wrapper; last-wins per key);
-        returns the compacted entry count. Checksum-corrupt entries
+        returns the compacted entry count, or ``-1`` when another
+        process's compactor holds the lease (the entries stay loose and
+        readable — refusal is never data loss). Checksum-corrupt entries
         quarantine and DROP here — compaction is where standing bit rot
         self-heals instead of re-quarantining on every read. Torn loose
         files quarantine and drop (bytes preserved in the sidecar); a
         torn compacted file refuses the rewrite typed (rewriting would
         erase whatever it still holds)."""
         with self._compact_lock:
-            return self._compact_locked(bucket)
+            if self.lease is None:
+                return self._compact_locked(bucket)
+            if not self.lease.acquire():
+                _logger.info(
+                    "another compactor holds %s; leaving bucket %s loose",
+                    self.lease.path, bucket,
+                )
+                return -1
+            try:
+                return self._compact_locked(bucket)
+            finally:
+                self.lease.release()
 
     def _compact_locked(self, bucket: str) -> int:
         import time as _time
@@ -284,6 +306,17 @@ class PartitionedMetricsRepository(MetricsRepository):
                     kept.append(entry)
             else:
                 kept.append(entry)
+        if self.lease is not None and not self.lease.renew():
+            # the FENCE: we stalled past the lease TTL mid-merge and a
+            # takeover happened — rewriting compacted.json now could drop
+            # entries the new holder merged. Abort with the bucket's loose
+            # files untouched (they stay readable; the live holder or a
+            # later compaction consumes them).
+            _logger.warning(
+                "compaction lease lost mid-merge; leaving bucket %s/%s "
+                "loose", self.path, bucket,
+            )
+            return -1
         stamp = _time.time_ns()
         dio.write_text_atomic(
             dio.join(bucket_dir, _COMPACTED),

@@ -669,9 +669,9 @@ class StreamingSession:
             )
             return None
 
-    def _store_contract(self) -> None:
-        path = self._contract_path()
-        if path is None:
+    def _store_contract(self, path: Optional[str] = None) -> None:
+        path = path if path is not None else self._contract_path()
+        if path is None or self._contract is None:
             return
         import json
 
@@ -784,6 +784,20 @@ class StreamingSession:
                 continue
             provider.persist(a, state)
             keys.append(analyzer_key(a))
+        # MIGRATE the schema contract alongside the states: a session
+        # re-opened on another host against this partition's provider
+        # loads the same checksummed contract in __init__, so drift
+        # policies fire identically pre- and post-migration — without
+        # this, the re-opened session would recapture its contract from
+        # the first batch the NEW host sees, and a producer that drifted
+        # in the gap would contaminate the migrated states unchallenged.
+        contract_path = getattr(provider, "path", None)
+        if contract_path is not None:
+            from .. import io as dio
+
+            self._store_contract(
+                dio.join(contract_path, self._CONTRACT_FILENAME)
+            )
         store.commit(
             self.dataset, name,
             fingerprint=contract_fingerprint(self._schema),

@@ -1,0 +1,156 @@
+"""Reusable multi-process spawn harness for cluster tests.
+
+Promoted from the ad-hoc plumbing inside ``tools/dcn_smoke.py`` (free
+port probing, worker env, file barriers, last-JSON-line parsing, the
+exit-2-means-skipped protocol) so every multi-process test — DCN smoke,
+cluster soak, lease interleaving — composes the same primitives instead
+of re-growing its own. Pure helpers, importable from both tests and
+tools.
+
+Protocol conventions these helpers encode:
+
+- a tool/worker prints its machine-readable result as the LAST stdout
+  line, as JSON;
+- exit code 2 with ``{"skipped": true}`` means the ENVIRONMENT cannot
+  run the scenario (e.g. no multi-process CPU collectives) — tests skip,
+  they don't fail;
+- cross-process synchronization uses file barriers in a shared temp dir
+  (create-to-signal, poll-to-wait): signal-safe, debuggable post-mortem,
+  and immune to the wedged-socket failure modes the drills create on
+  purpose.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (bind-to-0 probe)."""
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        return probe.getsockname()[1]
+
+
+def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env for a spawned cluster/DCN worker: CPU platform, ONE device per
+    process (mesh axes then span processes — the DCN path)."""
+    from deequ_tpu.parallel.dcn import dcn_worker_env
+
+    env = dcn_worker_env()
+    if extra:
+        env.update(extra)
+    return env
+
+
+def spawn_module(
+    module: str,
+    argv: Sequence[str] = (),
+    env: Optional[Dict[str, str]] = None,
+) -> subprocess.Popen:
+    """``python -m <module> <argv...>`` from the repo root with captured
+    stdout/stderr — the shape every multi-process scenario spawns."""
+    return subprocess.Popen(
+        [sys.executable, "-m", module, *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env if env is not None else worker_env(), cwd=REPO_ROOT,
+    )
+
+
+def last_json_line(raw: bytes) -> dict:
+    """The machine-readable result: last non-empty stdout line as JSON."""
+    lines = [ln for ln in raw.decode().strip().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("no stdout lines to parse")
+    return json.loads(lines[-1])
+
+
+def communicate_json(
+    proc: subprocess.Popen, timeout: float = 300.0
+) -> Tuple[int, dict, str]:
+    """Wait for ``proc``; returns ``(returncode, report, stderr_tail)``.
+    A process that died without parseable output reports
+    ``{"skipped": True, "reason": ...}`` so callers uniformly skip."""
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+    tail = err.decode()[-500:] if err else ""
+    try:
+        report = last_json_line(out)
+    except (ValueError, json.JSONDecodeError):
+        report = {
+            "ok": False, "skipped": True,
+            "reason": f"rc={proc.returncode}, no JSON output: {tail}",
+        }
+    return proc.returncode, report, tail
+
+
+def run_tool_json(
+    module: str,
+    argv: Sequence[str] = (),
+    timeout: float = 300.0,
+    env: Optional[Dict[str, str]] = None,
+) -> Tuple[int, dict]:
+    """Run a tool to completion and parse its JSON report line."""
+    proc = spawn_module(module, argv, env=env)
+    rc, report, _tail = communicate_json(proc, timeout=timeout)
+    return rc, report
+
+
+def skip_if_skipped(rc: int, report: dict) -> None:
+    """pytest.skip on the exit-2/"skipped" protocol (sandboxes without
+    multi-process CPU collectives must not fail the suite)."""
+    import pytest
+
+    if rc == 2 or report.get("skipped"):
+        pytest.skip(
+            f"environment cannot run scenario: "
+            f"{report.get('reason', 'skipped')}"
+        )
+
+
+def barrier_dir(prefix: str = "cluster-") -> str:
+    """Fresh shared temp dir for file barriers."""
+    return tempfile.mkdtemp(prefix=prefix)
+
+
+def signal_barrier(path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("ok")
+
+
+def wait_for_file(path: str, timeout_s: float = 60.0) -> bool:
+    """Poll until ``path`` exists (True) or the deadline passes (False)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return os.path.exists(path)
+
+
+def kill_and_reap(procs: List[subprocess.Popen]) -> List[str]:
+    """Kill every process and return stderr tails (failure diagnostics)."""
+    tails = []
+    for proc in procs:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        try:
+            _out, err = proc.communicate(timeout=10)
+            tails.append(err.decode()[-400:] if err else "")
+        except Exception:  # noqa: BLE001 - diagnostics only
+            tails.append("<unreapable>")
+    return tails

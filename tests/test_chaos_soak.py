@@ -45,6 +45,13 @@ def test_soak_is_deterministic_per_seed():
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_big_soak_invariants_hold():
-    summary = run_soak(jobs=50, stream_batches=12, rows=8192, seed=1, workers=4)
+    summary = run_soak(jobs=50, stream_batches=12, rows=8192, seed=1,
+                       workers=4, cluster_drill=True)
     assert summary["ok"], summary
     assert summary["faults_fired"] > 0  # the plan really exercised the run
+    # the multi-process kill-one drill ran (or skipped itself cleanly in
+    # an environment that cannot spawn the worker processes)
+    drill = summary["cluster_drill"]
+    assert drill["ok"], drill
+    if not drill["skipped"]:
+        assert drill["sessions_recovered"] >= 1, drill

@@ -1,0 +1,197 @@
+"""Compaction lease/fence protocol (ISSUE 16 satellite 4).
+
+The multi-writer partition store's single-compactor election: concurrent
+compactors refuse, stale leases take over after the TTL with a bumped
+epoch, a holder that loses the lease mid-merge aborts with every loose
+entry readable, and a real two-process write/compact interleaving loses
+no entry."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Size
+from deequ_tpu.data import Dataset
+from deequ_tpu.repository import (
+    PartitionedMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository.lease import FileLease
+from deequ_tpu.runners import AnalysisRunner
+
+pytestmark = pytest.mark.cluster
+
+DAY_MS = 86_400_000
+BASE_MS = 1_735_689_600_000  # 2025-01-01T00:00Z
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    data = Dataset.from_dict(
+        {"x": np.random.default_rng(0).normal(10, 2, 32)}
+    )
+    return AnalysisRunner.do_analysis_run(data, [Size()])
+
+
+def populate(repo, n, ctx, offset=0):
+    for d in range(n):
+        repo.save(ResultKey(BASE_MS + (offset + d) * DAY_MS), ctx)
+
+
+class TestLeaseProtocol:
+    def test_concurrent_compactor_refused(self, tmp_path, ctx):
+        """While one process's compactor holds the lease, another
+        repository's compact() is REFUSED (-1) and every entry stays
+        loose and readable — refusal is never data loss."""
+        root = str(tmp_path / "hist")
+        a = PartitionedMetricsRepository(root, compact_threshold=10_000)
+        b = PartitionedMetricsRepository(root, compact_threshold=10_000)
+        b.lease.owner = "other-host:999"  # distinct owner, same lease file
+        populate(a, 6, ctx)
+        assert a.lease.acquire()
+        try:
+            assert b.compact("2025-01") == -1
+            assert b.lease.refusals >= 1
+            assert len(b.load().get()) == 6  # loose entries still serve
+        finally:
+            a.lease.release()
+        # with the lease free, the refused compactor succeeds
+        assert b.compact("2025-01") == 6
+        assert len(b.load().get()) == 6
+
+    def test_stale_lease_takeover_after_ttl(self, tmp_path):
+        """A crashed holder's lease expires; the next contender takes
+        over by atomic rename with a BUMPED epoch, and the old holder's
+        fence checks fail from then on."""
+        path = str(tmp_path / "x.lease")
+        dead = FileLease(path, owner="dead:1", ttl_s=0.15)
+        live = FileLease(path, owner="live:2", ttl_s=30.0)
+        assert dead.acquire()
+        assert not live.acquire()  # still fresh: refused
+        assert live.refusals == 1
+        time.sleep(0.3)  # the holder "crashed"; its TTL lapses
+        assert live.acquire()
+        assert live.takeovers == 1
+        assert live.epoch == dead.epoch + 1  # the fence moved forward
+        assert not dead.held()
+        assert not dead.renew()  # the old holder can never fence again
+
+    def test_lease_lost_mid_merge_leaves_loose_entries(
+        self, tmp_path, ctx, monkeypatch
+    ):
+        """The FENCE: a compactor that stalls past its TTL and loses the
+        lease mid-merge must abort BEFORE the destructive rewrite —
+        every loose entry file survives and reads still merge them."""
+        root = str(tmp_path / "hist")
+        repo = PartitionedMetricsRepository(root, compact_threshold=10_000)
+        populate(repo, 5, ctx)
+        bucket_dir = tmp_path / "hist" / "2025-01"
+        loose_before = sorted(
+            f for f in os.listdir(bucket_dir) if f.startswith("e-")
+        )
+        assert len(loose_before) == 5
+        monkeypatch.setattr(
+            repo.lease, "renew", lambda: False
+        )  # the takeover happened while we merged
+        assert repo.compact("2025-01") == -1
+        loose_after = sorted(
+            f for f in os.listdir(bucket_dir) if f.startswith("e-")
+        )
+        assert loose_after == loose_before  # nothing deleted
+        assert not (bucket_dir / "compacted.json").exists()
+        assert len(repo.load().get()) == 5
+
+    def test_crash_mid_compaction_recovers_by_takeover(self, tmp_path, ctx):
+        """A lease file left behind by a crashed compactor defers
+        compaction at most one TTL: refused while fresh, taken over
+        once stale, and the data was readable throughout."""
+        root = str(tmp_path / "hist")
+        repo = PartitionedMetricsRepository(root, compact_threshold=10_000)
+        populate(repo, 4, ctx)
+        # simulate the crash: a foreign holder's lease file, never released
+        crashed = FileLease(repo.lease.path, owner="crashed:7", ttl_s=0.2)
+        assert crashed.acquire()
+        assert repo.compact("2025-01") == -1  # fresh foreign lease: refused
+        assert len(repo.load().get()) == 4
+        time.sleep(0.4)
+        assert repo.compact("2025-01") == 4  # stale: takeover + compact
+        assert repo.lease.takeovers == 1
+        assert len(repo.load().get()) == 4
+
+
+WRITER_SCRIPT = """
+import sys
+import numpy as np
+from deequ_tpu.analyzers import Size
+from deequ_tpu.data import Dataset
+from deequ_tpu.repository import PartitionedMetricsRepository, ResultKey
+from deequ_tpu.runners import AnalysisRunner
+
+root, n, offset = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+DAY_MS = 86_400_000
+BASE_MS = 1_735_689_600_000
+data = Dataset.from_dict({"x": np.random.default_rng(0).normal(10, 2, 32)})
+ctx = AnalysisRunner.do_analysis_run(data, [Size()])
+repo = PartitionedMetricsRepository(root, compact_threshold=10_000)
+for d in range(n):
+    repo.save(ResultKey(BASE_MS + (offset + d) * DAY_MS), ctx)
+    repo.compact("2025-01")
+print("done", flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessInterleaving:
+    def test_interleaved_write_compact_loses_no_entry(self, tmp_path, ctx):
+        """Two PROCESSES interleave appends and compactions on one store
+        root under the lease: every entry either survives loose or lands
+        in compacted.json — none is dropped by a racing rewrite."""
+        root = str(tmp_path / "hist")
+        n_child = 12
+        child_offset = 12  # days 12-23: SAME 2025-01 bucket as the parent
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        child = subprocess.Popen(
+            [sys.executable, "-c", WRITER_SCRIPT, root, str(n_child),
+             str(child_offset)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        repo = PartitionedMetricsRepository(root, compact_threshold=10_000)
+        n_parent = 12
+        written = 0
+        deadline = time.monotonic() + 240
+        while written < n_parent or child.poll() is None:
+            if time.monotonic() > deadline:
+                child.kill()
+                pytest.fail("interleaving run timed out")
+            if written < n_parent:
+                repo.save(
+                    ResultKey(BASE_MS + written * DAY_MS), ctx
+                )
+                written += 1
+                repo.compact("2025-01")
+            else:
+                time.sleep(0.05)
+        out, err = child.communicate(timeout=30)
+        assert child.returncode == 0, err.decode()[-500:]
+        assert b"done" in out
+        # every key from both writers present exactly once (distinct
+        # timestamps; last-wins merge never collapses distinct keys)
+        final = PartitionedMetricsRepository(root)
+        stamps = sorted(e.result_key.data_set_date for e in
+                        final.load().get())
+        want = sorted(
+            [BASE_MS + d * DAY_MS for d in range(n_parent)]
+            + [BASE_MS + (child_offset + d) * DAY_MS
+               for d in range(n_child)]
+        )
+        assert stamps == want
+        # and a final elected compaction folds them all into one file
+        assert final.compact("2025-01") == n_parent + n_child
+        assert len(final.load().get()) == n_parent + n_child

@@ -1,0 +1,215 @@
+"""Session migration + host-loss recovery, in-process (ISSUE 16).
+
+The tentpole's fold-boundary migration contract: a session moves hosts
+as flush-on-old / adopt-on-new through the shared partition store,
+carrying BOTH its cumulative algebraic states and its checksummed
+schema contract (satellite 2's pin — a drifted producer must be
+challenged identically pre- and post-migration). Plus the front tier's
+loss path: ring re-hash, adoption, journal replay, typed counters."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.cluster import (
+    FrontTier,
+    HeartbeatMembership,
+    HostLossError,
+    LocalWorker,
+)
+from deequ_tpu.exceptions import SchemaDriftError
+from deequ_tpu.service import VerificationService
+
+pytestmark = pytest.mark.cluster
+
+
+def make_check():
+    return Check(CheckLevel.ERROR, "mig").is_complete("id").has_size(
+        lambda n: n > 0
+    )
+
+
+def batch(i, rows=16):
+    base = i * rows
+    return {
+        "id": np.arange(base, base + rows, dtype=np.float64),
+        "v": np.ones(rows, dtype=np.float64),
+    }
+
+
+def metric_map(result):
+    return {
+        (type(a).__name__, str(getattr(a, "column", "")), m.name): m.value
+        for a, m in result.metrics.items()
+    }
+
+
+@pytest.fixture()
+def store_root(tmp_path):
+    return str(tmp_path / "store")
+
+
+def make_worker(host_id, store_root, hb_root=None, ttl_s=5.0):
+    service = VerificationService(
+        workers=1, background_warm=False, partition_store=store_root
+    )
+    membership = None
+    if hb_root is not None:
+        membership = HeartbeatMembership(
+            hb_root, host_id=host_id, heartbeat_period_s=0.1, ttl_s=ttl_s
+        )
+    return LocalWorker(host_id, service, membership=membership)
+
+
+class TestContractMigration:
+    def test_flush_writes_contract_beside_partition_states(
+        self, tmp_path, store_root
+    ):
+        """Satellite 2's mechanism: the flush that moves states into the
+        partition store writes the checksummed schema contract beside
+        them."""
+        import os
+
+        worker = make_worker("w0", store_root)
+        session = worker.open_session("t", "events", [make_check()])
+        session.ingest(batch(0))
+        name = worker.flush("t", "events")
+        assert name == "session-t"
+        store = worker.service.partition_store
+        provider = store.provider("events", name)
+        contract_path = os.path.join(provider.path, "schema-contract.json")
+        assert os.path.exists(contract_path)
+        worker.close()
+
+    def test_migrated_session_enforces_original_contract(self, store_root):
+        """THE PIN: a session adopted on a new host must reject a batch
+        whose schema drifted from the ORIGINAL session's contract — the
+        re-opened session loads the migrated contract instead of
+        recapturing one from the drifted producer's first batch."""
+        source = make_worker("w0", store_root)
+        source.open_session("t", "events", [make_check()])
+        source.ingest("t", "events", batch(0))
+        assert source.release("t", "events") == "session-t"
+        source.close()
+
+        target = make_worker("w1", store_root)
+        adopted = target.adopt_session("t", "events", [make_check()])
+        assert adopted._contract is not None  # loaded, not recaptured
+        drifted = {
+            "id": np.arange(16, dtype=np.float64)
+            # column "v" dropped: hard drift vs the migrated contract
+        }
+        with pytest.raises(SchemaDriftError):
+            adopted.ingest(drifted)
+        # the original schema still folds fine — and resumes the counts
+        adopted.ingest(batch(1))
+        assert adopted.batches_ingested == 1
+        size = [
+            m for a, m in adopted.current().metrics.items()
+            if type(a).__name__ == "Size"
+        ][0]
+        assert size.value.get() == 32.0  # 16 pre-migration + 16 post
+        target.close()
+
+
+class TestFrontTierMigration:
+    def test_graceful_migration_preserves_metrics(self, store_root, tmp_path):
+        front = FrontTier()
+        front.add_worker(make_worker("w0", store_root))
+        front.add_worker(make_worker("w1", store_root))
+        front.open_session("t", "events", [make_check()])
+        for i in range(3):
+            front.ingest("t", "events", batch(i))
+        placed = front.placement("t", "events")
+        other = [h for h in front.workers if h != placed][0]
+        before = metric_map(
+            front.workers[placed].service.get_session("t", "events").current()
+        )
+        # drain the placed host: its sessions must move gracefully
+        front.remove_worker(placed)
+        assert front.placement("t", "events") == other
+        after = metric_map(
+            front.workers[other].service.get_session("t", "events").current()
+        )
+        assert after == before
+        assert front.metrics.counter_value(
+            "deequ_service_cluster_migrations_total"
+        ) >= 1
+        front.close()
+
+    def test_host_loss_recovers_by_salvage_plus_replay(self, store_root):
+        """Loss recovery parity: last-flush states from the store + the
+        journaled post-flush folds replayed equals the lost session,
+        fold for fold — proven by the same metrics as a never-lost
+        oracle, and by the typed cluster counters."""
+        front = FrontTier()
+        front.add_worker(make_worker("w0", store_root))
+        front.add_worker(make_worker("w1", store_root))
+        front.open_session("t", "events", [make_check()])
+        for i in range(2):
+            front.ingest("t", "events", batch(i))
+        front.flush("t", "events")  # fold boundary: journal clears
+        for i in range(2, 5):
+            front.ingest("t", "events", batch(i))  # journaled, unflushed
+
+        victim = front.placement("t", "events")
+        recovered = front.handle_host_loss(victim)
+        assert recovered == [("t", "events")]
+        survivor = front.placement("t", "events")
+        assert survivor != victim
+
+        oracle = VerificationService(workers=1, background_warm=False)
+        session = oracle.session("t", "oracle", [make_check()])
+        for i in range(5):
+            session.ingest(batch(i))
+        want = metric_map(session.current())
+        got = metric_map(
+            front.workers[survivor].service.get_session(
+                "t", "events"
+            ).current()
+        )
+        assert got == want
+        m = front.metrics
+        assert m.counter_value(
+            "deequ_service_cluster_host_losses_total") == 1
+        assert m.counter_value(
+            "deequ_service_cluster_sessions_recovered_total") == 1
+        assert m.counter_value(
+            "deequ_service_cluster_replayed_folds_total") == 3
+        oracle.close()
+        front.close()
+
+    def test_loss_with_no_survivors_raises_typed(self, store_root):
+        front = FrontTier()
+        front.add_worker(make_worker("w0", store_root))
+        front.open_session("t", "events", [make_check()])
+        with pytest.raises(HostLossError):
+            front.handle_host_loss("w0")
+
+    def test_membership_sweep_drives_recovery(self, store_root, tmp_path):
+        """End to end inside one process: a worker that stops beating is
+        declared lost by the TTL scan and its sessions recover."""
+        hb = str(tmp_path / "hb")
+        front = FrontTier(
+            membership=HeartbeatMembership(hb, ttl_s=0.4)
+        )
+        w0 = make_worker("w0", store_root, hb_root=hb, ttl_s=0.4)
+        w1 = make_worker("w1", store_root, hb_root=hb, ttl_s=0.4)
+        front.add_worker(w0)
+        front.add_worker(w1)
+        front.open_session("t", "events", [make_check()])
+        front.ingest("t", "events", batch(0))
+        victim_id = front.placement("t", "events")
+        victim = front.workers[victim_id]
+        victim.membership.stop()  # the "crash": beats stop, service lives
+        import time
+
+        time.sleep(0.8)  # let the TTL lapse
+        handled = front.check_membership()
+        assert handled == [victim_id]
+        assert front.placement("t", "events") != victim_id
+        # the survivor replays the only (journaled, never-flushed) fold
+        assert front.metrics.counter_value(
+            "deequ_service_cluster_replayed_folds_total") == 1
+        front.close()
+        victim.service.close()

@@ -1,0 +1,132 @@
+"""Cluster tier: consistent-hash ring + heartbeat membership.
+
+The routing and liveness primitives under the front tier (ISSUE 16
+tentpole): stable cross-process hashing, bounded key movement on
+membership changes, TTL-declared host loss, and the chaos probes
+(``ring_rebalance``, ``host_heartbeat``) that let drills fail them on
+purpose."""
+
+import time
+
+import pytest
+
+from deequ_tpu.cluster import (
+    HashRing,
+    HeartbeatMembership,
+    HostLossError,
+    ring_vnodes,
+)
+from deequ_tpu.reliability.faults import FaultSpec, inject
+
+pytestmark = pytest.mark.cluster
+
+
+KEYS = [f"tenant-{i % 7}/stream-{i}" for i in range(400)]
+
+
+class TestHashRing:
+    def test_routing_is_deterministic_across_instances(self):
+        """Every front-tier replica must route identically: the ring is
+        a pure function of (host set, vnodes) — no process salt."""
+        a = HashRing(["w0", "w1", "w2"], vnodes=64)
+        b = HashRing(["w2", "w0", "w1"], vnodes=64)  # order must not matter
+        assert [a.route(k) for k in KEYS] == [b.route(k) for k in KEYS]
+
+    def test_distribution_is_roughly_balanced(self):
+        ring = HashRing(["w0", "w1", "w2", "w3"], vnodes=64)
+        counts = {h: 0 for h in ring.hosts}
+        for k in KEYS:
+            counts[ring.route(k)] += 1
+        share = len(KEYS) / len(counts)
+        for host, n in counts.items():
+            assert 0.4 * share <= n <= 1.8 * share, (host, counts)
+
+    def test_add_host_moves_only_a_fraction(self):
+        """THE consistent-hashing contract: adding one host re-homes
+        ~1/N of keys, and every moved key lands ON the new host."""
+        before = HashRing(["w0", "w1", "w2"], vnodes=64)
+        after = before.snapshot()
+        after.add_host("w3")
+        moved = after.moved_keys(KEYS, before)
+        assert 0 < len(moved) < len(KEYS) // 2
+        assert all(dst == "w3" for _src, dst in moved.values())
+
+    def test_remove_host_moves_only_its_keys(self):
+        before = HashRing(["w0", "w1", "w2"], vnodes=64)
+        after = before.snapshot()
+        after.remove_host("w1")
+        moved = after.moved_keys(KEYS, before)
+        assert moved, "w1 owned some of 400 keys"
+        for key, (src, dst) in moved.items():
+            assert src == "w1" and dst != "w1", (key, src, dst)
+        # unmoved keys still route where they did
+        unmoved = [k for k in KEYS if k not in moved]
+        assert all(after.route(k) == before.route(k) for k in unmoved)
+
+    def test_empty_ring_raises_lookup_error(self):
+        with pytest.raises(LookupError):
+            HashRing().route("t/d")
+
+    def test_vnodes_env_knob(self, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_CLUSTER_VNODES", "8")
+        assert ring_vnodes() == 8
+        assert HashRing(["w0"]).vnodes == 8
+        monkeypatch.setenv("DEEQU_TPU_CLUSTER_VNODES", "not-a-number")
+        assert ring_vnodes() == 64  # warn-once keep-default parser
+
+    def test_ring_rebalance_fault_site_is_live(self):
+        """Chaos plans can fail the re-hash mid-membership-change."""
+        ring = HashRing(["w0"])
+        with inject(FaultSpec(site="ring_rebalance", kind="host_loss",
+                              at=1)):
+            with pytest.raises(HostLossError):
+                ring.add_host("w1")
+
+
+class TestHeartbeatMembership:
+    def test_beat_then_scan_alive(self, tmp_path):
+        mem = HeartbeatMembership(str(tmp_path), host_id="w0", ttl_s=5.0)
+        mem.beat()
+        alive, lost = HeartbeatMembership(str(tmp_path), ttl_s=5.0).scan()
+        assert alive == ["w0"] and lost == []
+
+    def test_ttl_expiry_declares_lost_and_retire_clears(self, tmp_path):
+        mem = HeartbeatMembership(str(tmp_path), host_id="w0", ttl_s=0.1)
+        mem.beat()
+        time.sleep(0.25)
+        reader = HeartbeatMembership(str(tmp_path), ttl_s=0.1)
+        alive, lost = reader.scan()
+        assert alive == [] and lost == ["w0"]
+        reader.retire("w0")
+        assert reader.scan() == ([], [])
+
+    def test_background_beater_keeps_host_alive(self, tmp_path):
+        mem = HeartbeatMembership(
+            str(tmp_path), host_id="w0",
+            heartbeat_period_s=0.05, ttl_s=0.3,
+        )
+        mem.start()
+        try:
+            time.sleep(0.5)  # several TTLs: only the beater keeps it alive
+            alive, lost = HeartbeatMembership(str(tmp_path), ttl_s=0.3).scan()
+            assert alive == ["w0"] and lost == []
+        finally:
+            mem.stop()
+
+    def test_host_heartbeat_fault_declares_host_lost(self, tmp_path):
+        """An injected host_loss fault at the heartbeat probe declares a
+        LIVE host dead — the drills' loss path without killing anything."""
+        for host in ("w0", "w1"):
+            HeartbeatMembership(str(tmp_path), host_id=host,
+                                ttl_s=30.0).beat()
+        reader = HeartbeatMembership(str(tmp_path), ttl_s=30.0)
+        with inject(FaultSpec(site="host_heartbeat", kind="host_loss",
+                              match="w1")):
+            alive, lost = reader.scan()
+        assert alive == ["w0"] and lost == ["w1"]
+
+    def test_torn_beat_files_are_skipped(self, tmp_path):
+        (tmp_path / "host-evil.json").write_text("{not json")
+        mem = HeartbeatMembership(str(tmp_path), host_id="w0", ttl_s=5.0)
+        mem.beat()
+        assert list(mem.members()) == ["w0"]

@@ -68,6 +68,9 @@ _SCALARS: List[Tuple[str, str, str]] = [
     ("grouping", "grouping_rows_per_sec", "throughput"),
     ("spill", "spill_rows_per_sec", "throughput"),
     ("streaming_knee", "streaming_knee_sessions_per_s", "throughput"),
+    # cluster tier (ISSUE 16): 2-process aggregate sessions/s through the
+    # consistent-hash front tier, parity-gated in the stage itself
+    ("cluster_soak", "cluster_soak_sessions_per_s", "throughput"),
     ("grouping", "grouping_peak_rss_gb", "rss"),
     ("spill", "spill_peak_rss_gb", "rss"),
     # incremental verification (ISSUE 13): the +1%-growth point's

@@ -27,6 +27,11 @@ host-side semigroup folds, no collectives, because the mesh is gone — and
 completes the fold. Exit 0 iff the survivor's salvaged metrics equal the
 single-process oracle to 1e-9 relative (the same parity bar as the main
 smoke).
+
+This CLI is a THIN wrapper: the worker-side mechanics (bring-up env,
+partial stacking, deadline-guarded folds, salvage + replay) live in
+``deequ_tpu.parallel.dcn`` — the library the cluster tier composes — and
+this module only wires them to the spawn/barrier/JSON protocol.
 """
 
 from __future__ import annotations
@@ -98,45 +103,28 @@ def worker(process_id: int, port: int) -> None:
     """One of the two distributed processes. Prints a JSON result line."""
     import jax
 
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=2,
-        process_id=process_id,
-    )
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 2, jax.device_count()
-
-    import numpy as np
-
-    from deequ_tpu.analyzers.base import HostBatchContext
     from deequ_tpu.parallel import (
         collective_merge_states,
         make_mesh,
-        sharded_ingest_fold,
         stack_identity_states,
     )
+    from deequ_tpu.parallel.dcn import (
+        fold_partials,
+        host_partials,
+        initialize_dcn,
+    )
+
+    initialize_dcn(f"127.0.0.1:{port}", num_processes=2,
+                   process_id=process_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
 
     analyzers = _battery()
-    data = _data(ROWS)
-    partials = []
-    for index, batch in enumerate(
-        data.batches(ROWS // BATCHES, pad_to_batch_size=False)
-    ):
-        ctx = HostBatchContext(batch, batch_index=index)
-        partials.append(tuple(a.host_partial(ctx) for a in analyzers))
-    stacked = tuple(
-        jax.tree_util.tree_map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]),
-            *[p[i] for p in partials],
-        )
-        for i in range(len(analyzers))
-    )
-    flags = np.ones(len(partials), dtype=bool)
+    partials = host_partials(analyzers, _data(ROWS), ROWS // BATCHES)
 
     mesh = make_mesh()  # ALL global devices: one per process -> DCN axis
     states = stack_identity_states(analyzers, mesh.devices.size)
-    folded = sharded_ingest_fold(analyzers, mesh, states, stacked, flags)
+    folded = fold_partials(analyzers, mesh, states, partials)
     merged = collective_merge_states(analyzers, mesh, folded)
     print(
         json.dumps(
@@ -158,52 +146,35 @@ def drill_worker(process_id: int, port: int, barrier_dir: str) -> None:
 
     import jax
 
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=2,
-        process_id=process_id,
-    )
-
-    import numpy as np
-
-    from deequ_tpu.analyzers.base import HostBatchContext
     from deequ_tpu.parallel import (
         collective_merge_states,
         make_mesh,
-        sharded_ingest_fold,
         stack_identity_states,
     )
+    from deequ_tpu.parallel.dcn import (
+        DEFAULT_DCN_DEADLINE_S,
+        fold_partials,
+        host_partials,
+        initialize_dcn,
+        replay_partials,
+        salvage_local_states,
+        with_deadline,
+    )
+
+    initialize_dcn(f"127.0.0.1:{port}", num_processes=2,
+                   process_id=process_id)
 
     analyzers = _battery()
-    data = _data(ROWS)
-    partials = []
-    for index, batch in enumerate(
-        data.batches(ROWS // BATCHES, pad_to_batch_size=False)
-    ):
-        ctx = HostBatchContext(batch, batch_index=index)
-        partials.append(tuple(a.host_partial(ctx) for a in analyzers))
-
-    def stack(group):
-        return tuple(
-            jax.tree_util.tree_map(
-                lambda *xs: np.stack([np.asarray(x) for x in xs]),
-                *[p[i] for p in group],
-            )
-            for i in range(len(analyzers))
-        )
+    partials = host_partials(analyzers, _data(ROWS), ROWS // BATCHES)
 
     half = len(partials) // 2
-    chunks = [partials[:half], partials[half:]]
     mesh = make_mesh()
     n_dev = int(mesh.devices.size)  # 2: one device per process
     local = half // n_dev
     states = stack_identity_states(analyzers, n_dev)
-    flags = np.ones(half, dtype=bool)
 
     # chunk 1 folds on the healthy mesh
-    states = sharded_ingest_fold(analyzers, mesh, states, stack(chunks[0]), flags)
-    jax.block_until_ready(jax.tree_util.tree_leaves(states))
+    states = fold_partials(analyzers, mesh, states, partials[:half])
     #: batch indices THIS process's device (shard = process_id) folded
     owned = set(range(process_id * local, (process_id + 1) * local))
     open(os.path.join(barrier_dir, f"w{process_id}-fold1"), "w").write("ok")
@@ -219,42 +190,20 @@ def drill_worker(process_id: int, port: int, barrier_dir: str) -> None:
             break
         time.sleep(0.1)
 
-    def with_deadline(fn, seconds: float):
-        """Run fn on a daemon thread; (value, error, timed_out)."""
-        import threading
-
-        box: dict = {}
-        done = threading.Event()
-
-        def body():
-            try:
-                box["value"] = fn()
-            except BaseException as exc:  # noqa: BLE001
-                box["error"] = exc
-            finally:
-                done.set()
-
-        threading.Thread(target=body, daemon=True).start()
-        timed_out = not done.wait(seconds)
-        return box.get("value"), box.get("error"), timed_out
-
     # attempt chunk 2 + the collective merge against the dead peer: either
     # step failing (or hanging past the deadline) IS the loss signal
     salvage_reason = None
 
-    def fold2():
-        out = sharded_ingest_fold(
-            analyzers, mesh, states, stack(chunks[1]), flags
-        )
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
-        return out
-
-    folded2, err, timed_out = with_deadline(fold2, 15.0)
+    folded2, err, timed_out = with_deadline(
+        lambda: fold_partials(analyzers, mesh, states, partials[half:]),
+        DEFAULT_DCN_DEADLINE_S,
+    )
     if folded2 is not None:
         states = folded2
         owned |= set(range(half + 0 * local, half + local))
         merged, err, timed_out = with_deadline(
-            lambda: collective_merge_states(analyzers, mesh, states), 15.0
+            lambda: collective_merge_states(analyzers, mesh, states),
+            DEFAULT_DCN_DEADLINE_S,
         )
         if merged is not None:
             # the dead peer did not block the merge (environment folded it
@@ -275,29 +224,37 @@ def drill_worker(process_id: int, port: int, barrier_dir: str) -> None:
     # SALVAGE: this process's addressable shard of the folded states is the
     # surviving state; every batch it does NOT cover replays from the local
     # data copy with eager host-side semigroup folds (the mesh is gone)
-    def local_shard(tree):
-        return jax.tree_util.tree_map(
-            lambda x: np.asarray(x.addressable_data(0))[0]
-            if isinstance(x, jax.Array) and not x.is_fully_addressable
-            else np.asarray(x[0]),
-            tree,
-        )
-
-    salvaged = tuple(local_shard(tree) for tree in states)
+    salvaged = salvage_local_states(states)
     replay = [i for i in range(len(partials)) if i not in owned]
-    finished = []
-    for i, a in enumerate(analyzers):
-        acc = salvaged[i]
-        for j in replay:
-            acc = a.ingest_partial(acc, partials[j][i])
-        finished.append(acc)
+    finished = replay_partials(analyzers, salvaged, partials, replay)
     print(json.dumps({
         "process": 0, "salvaged": True, "salvage_reason": salvage_reason,
         "replayed_batches": len(replay),
-        "values": _metric_values(analyzers, tuple(finished)),
+        "values": _metric_values(analyzers, finished),
     }), flush=True)
     os._exit(0)  # noqa: SLF001 - the distributed runtime lost its peer;
     # a normal exit would hang in teardown barriers
+
+
+def _free_port() -> int:
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        return probe.getsockname()[1]
+
+
+def _spawn_workers(port: int, extra_argv=()) -> list:
+    from deequ_tpu.parallel.dcn import dcn_worker_env
+
+    return [
+        subprocess.Popen(
+            [sys.executable, "-m", "tools.dcn_smoke", "--worker", str(i),
+             "--port", str(port), *extra_argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=dcn_worker_env(),
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
 
 
 def run_kill_one_drill() -> int:
@@ -307,23 +264,11 @@ def run_kill_one_drill() -> int:
     import time
 
     expected = single_process_expected()
-    with socket.socket() as probe:
-        probe.bind(("127.0.0.1", 0))
-        port = probe.getsockname()[1]
+    port = _free_port()
     barrier_dir = tempfile.mkdtemp(prefix="dcn-drill-")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "tools.dcn_smoke", "--worker", str(i),
-             "--port", str(port), "--drill", "kill-one",
-             "--barrier", barrier_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        for i in range(2)
-    ]
+    procs = _spawn_workers(
+        port, ["--drill", "kill-one", "--barrier", barrier_dir]
+    )
     # wait for worker 1's first fold, then SIGKILL it mid-fold
     w1_folded = os.path.join(barrier_dir, "w1-fold1")
     deadline = time.monotonic() + 240
@@ -392,23 +337,7 @@ def main() -> int:
         return run_kill_one_drill()
 
     expected = single_process_expected()
-    with socket.socket() as probe:
-        probe.bind(("127.0.0.1", 0))
-        port = probe.getsockname()[1]
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # one CPU device per process: the mesh axis then SPANS processes, so
-    # every collective crosses the process boundary — the DCN path
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "tools.dcn_smoke", "--worker", str(i),
-             "--port", str(port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        for i in range(2)
-    ]
+    procs = _spawn_workers(_free_port())
     results, errors = [], []
     for proc in procs:
         try:
