@@ -1282,6 +1282,67 @@ def run_cluster_soak_stage(
     }
 
 
+def run_catalog_soak_stage(
+    registered: int = 400, active: int = 24,
+    gate_batches: int = 24, gate_rows: int = 65_536,
+) -> dict:
+    """Tenant isolation plane (tools/catalog_soak.py): registered >>
+    active catalog tiering with the mid-soak edit and corrupt-edit
+    drills, plus the gated-vs-ungated throughput fraction (acceptance
+    floor 0.8; tools/bench_diff tracks it as a throughput scalar so the
+    row gate's steady-state cost cannot silently grow). Runs DETACHED so
+    the soak's service plane starts cold."""
+    import json as _json
+    import os
+    import subprocess
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.catalog_soak", "--stage-json",
+            "--registered", str(registered), "--active", str(active),
+            "--gate-batches", str(gate_batches),
+            "--gate-rows", str(gate_rows),
+        ],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=subprocess_timeout_s(),
+    )
+    if not proc.stdout.strip():
+        raise RuntimeError(
+            f"catalog_soak subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}"
+        )
+    summary = _json.loads(proc.stdout.strip().splitlines()[-1])
+    if not summary["ok"]:
+        log(
+            "catalog soak VERDICT FAILED: "
+            f"soak={summary['soak'].get('ok')} "
+            f"gate={summary['gate'].get('ok')} "
+            f"fraction={summary['gated_throughput_fraction']}"
+        )
+        sys.exit(1)
+    log(
+        f"[catalog_soak] {registered} registered / {active} active: "
+        f"{summary['soak']['sessions_per_s']:.1f} sessions/s hot, "
+        f"edit + corrupt drills ok; gate fraction "
+        f"{summary['gated_throughput_fraction']:.2f} "
+        f"({summary['gate']['gated_mb_per_s']:.0f} vs "
+        f"{summary['gate']['ungated_mb_per_s']:.0f} MB/s), bit-exact"
+    )
+    return {
+        "registered": registered,
+        "active": active,
+        "sessions_per_s": summary["soak"]["sessions_per_s"],
+        "registers_per_s": summary["soak"]["registers_per_s"],
+        "edit_drill": summary["soak"]["edit_drill"]["ok"],
+        "corrupt_drill": summary["soak"]["corrupt_drill"]["ok"],
+        "gated_throughput_fraction": summary["gated_throughput_fraction"],
+        "gated_mb_per_s": summary["gate"]["gated_mb_per_s"],
+        "ungated_mb_per_s": summary["gate"]["ungated_mb_per_s"],
+        "stage_seconds": time.perf_counter() - t0,
+    }
+
+
 # ---------------------------------------------------------------------------
 # stage 3: incremental/stateful partitions + sketch-state merge (BASELINE
 # config 4: partition states persisted, table metrics refreshed from merged
@@ -2000,6 +2061,24 @@ def main() -> None:
     elif cluster_soak is not None:
         checkpoint("cluster_soak", status="skipped_env",
                    extra={"reason": cluster_soak.get("reason")})
+
+    catalog_soak = staged(
+        "catalog_soak", run_catalog_soak_stage,
+        # one detached soak process with its own interpreter startup
+        budget_s=subprocess_timeout_s() + 30,
+    )
+    if catalog_soak is not None:
+        out["catalog_soak_sessions_per_s"] = catalog_soak["sessions_per_s"]
+        out["gated_throughput_fraction"] = catalog_soak[
+            "gated_throughput_fraction"
+        ]
+        checkpoint("catalog_soak", extra={
+            "registered": catalog_soak["registered"],
+            "active": catalog_soak["active"],
+            "registers_per_s": catalog_soak["registers_per_s"],
+            "gated_mb_per_s": catalog_soak["gated_mb_per_s"],
+            "ungated_mb_per_s": catalog_soak["ungated_mb_per_s"],
+        })
 
     mesh_scaling = staged(
         "mesh_scaling", run_mesh_scaling_stage,

@@ -27,7 +27,12 @@ makes MANY of those hosts act as one service (ROADMAP item 3):
 
 from __future__ import annotations
 
-from .front import FrontTier
+from .front import (
+    CLUSTER_JOURNAL_MAX_FOLDS_ENV,
+    DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS,
+    FrontTier,
+    cluster_journal_max_folds,
+)
 from .membership import (
     DEFAULT_HEARTBEAT_S,
     DEFAULT_HOST_TTL_S,
@@ -42,6 +47,8 @@ from .ring import DEFAULT_VNODES, VNODES_ENV, HashRing, ring_vnodes
 from .worker import LocalWorker, session_partition
 
 __all__ = [
+    "CLUSTER_JOURNAL_MAX_FOLDS_ENV",
+    "DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS",
     "DEFAULT_HEARTBEAT_S",
     "DEFAULT_HOST_TTL_S",
     "DEFAULT_VNODES",
@@ -53,6 +60,7 @@ __all__ = [
     "HeartbeatMembership",
     "HostLossError",
     "LocalWorker",
+    "cluster_journal_max_folds",
     "describe_cluster_series",
     "heartbeat_s",
     "host_ttl_s",
@@ -94,4 +102,10 @@ def describe_cluster_series(metrics) -> None:
         "deequ_service_cluster_replayed_folds_total",
         "Journaled folds replayed into recovered sessions (the window "
         "between the dead host's last flush and its loss).",
+    )
+    metrics.describe(
+        "deequ_service_cluster_journal_flushes_total",
+        "Force-flushes triggered by a session's replay journal reaching "
+        "DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS payloads (bounds replay "
+        "memory for producers that never flush).",
     )

@@ -40,6 +40,24 @@ from .worker import LocalWorker, session_partition
 
 _logger = logging.getLogger(__name__)
 
+#: folds a session's replay journal may hold before the front tier
+#: FORCE-FLUSHES the session to the partition store (clearing the
+#: journal): the journal exists to replay the window since the last
+#: flush, and a producer that never calls flush() would otherwise grow
+#: it one payload per fold for the session's whole life. Warn-once
+#: parser; minimum 1 (a bound of 0 would force-flush every fold).
+CLUSTER_JOURNAL_MAX_FOLDS_ENV = "DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS"
+DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS = 256
+
+
+def cluster_journal_max_folds() -> int:
+    from ..utils import env_number
+
+    return int(env_number(
+        CLUSTER_JOURNAL_MAX_FOLDS_ENV, DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS,
+        int, minimum=1,
+    ))
+
 
 def _key(tenant: str, dataset: str) -> Tuple[str, str]:
     return (str(tenant), str(dataset))
@@ -77,8 +95,10 @@ class FrontTier:
         self._placements: Dict[Tuple[str, str], str] = {}
         #: key -> payloads accepted since the last flush — the replay
         #: log that makes loss recovery exact (cleared at every flush,
-        #: so it holds one fold window, not the session's life)
+        #: so it holds one fold window, not the session's life; bounded
+        #: by a force-flush at DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS)
         self._journal: Dict[Tuple[str, str], List[Any]] = {}
+        self._journal_max_folds = cluster_journal_max_folds()
 
     # -- membership ------------------------------------------------------
 
@@ -164,7 +184,10 @@ class FrontTier:
     def ingest(self, tenant: str, dataset: str, data, **kw):
         """Forward one micro-batch to the session's host (migrating
         first if the ring re-homed the key) and journal the payload for
-        loss replay."""
+        loss replay. A journal that reaches
+        ``DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS`` payloads force-flushes
+        the session AFTER this fold commits — bounding replay memory for
+        producers that never reach a natural flush boundary."""
         key = _key(tenant, dataset)
         with self._lock:
             if key not in self._placements:
@@ -176,8 +199,20 @@ class FrontTier:
             if owner != self._placements[key]:
                 self._migrate_locked(key, owner)
             worker = self.workers[self._placements[key]]
-            self._journal.setdefault(key, []).append(data)
-        return worker.ingest(tenant, dataset, data, **kw)
+            journal = self._journal.setdefault(key, [])
+            journal.append(data)
+            force_flush = len(journal) >= self._journal_max_folds
+        result = worker.ingest(tenant, dataset, data, **kw)
+        if force_flush:
+            # flush only AFTER the worker committed this fold: flushing
+            # first would clear a journal entry whose fold has not
+            # reached the session yet — a host loss in that window would
+            # replay nothing and lose the payload
+            self.flush(tenant, dataset)
+            self.metrics.inc(
+                "deequ_service_cluster_journal_flushes_total"
+            )
+        return result
 
     def flush(self, tenant: str, dataset: str) -> Optional[str]:
         """Fold boundary: flush the session's cumulative states (+

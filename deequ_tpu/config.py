@@ -366,3 +366,40 @@ from .cluster.ring import VNODES_ENV as CLUSTER_VNODES_ENV  # noqa: E402,F401
 from .repository.lease import (  # noqa: E402,F401
     LEASE_TTL_ENV as CLUSTER_LEASE_TTL_ENV,
 )
+
+# ---------------------------------------------------------------------------
+# Tenant isolation plane (deequ_tpu.service.catalog + deequ_tpu.ingest.
+# rowgate + the cluster front tier's journal bound)
+# ---------------------------------------------------------------------------
+#
+# - DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS: payloads a session's loss-replay
+#   journal may hold before the front tier force-flushes the session to
+#   the partition store and clears it (default 256; minimum 1). The
+#   journal replays the window since the last flush after a host loss; a
+#   producer that never calls flush() would otherwise grow it one
+#   payload per fold, unbounded, for the session's whole life.
+# - DEEQU_TPU_CATALOG_HOT_TTL_S: seconds a catalog-opened session may sit
+#   idle in the HOT tier before the plane's sweep() closes it back to
+#   COLD (default 300.0; minimum 1.0). Cold tenants cost one registry
+#   row, not a session — registration scales past active capacity.
+# - DEEQU_TPU_CATALOG_POLL_S: debounce on the fold-boundary version poll
+#   of a hot tenant's catalog document (default 2.0; minimum 0.0). A
+#   catalog edit becomes effective within one poll interval at the next
+#   fold boundary — no restart; 0 polls every fold.
+# - DEEQU_TPU_ROWGATE_QUARANTINE_MAX_ROWS: total rows a quarantine
+#   sidecar retains per (tenant, dataset) before further rejects are
+#   counted but dropped (default 100000; minimum 0). Bounds the disk a
+#   misbehaving producer can consume with nonconforming rows.
+#
+# All four parse via the shared warn-once utils.env_* readers:
+# unparseable or out-of-range values log once and keep the default.
+from .cluster.front import (  # noqa: E402,F401
+    CLUSTER_JOURNAL_MAX_FOLDS_ENV,
+)
+from .ingest.rowgate import (  # noqa: E402,F401
+    QUARANTINE_MAX_ROWS_ENV as ROWGATE_QUARANTINE_MAX_ROWS_ENV,
+)
+from .service.catalog import (  # noqa: E402,F401
+    CATALOG_HOT_TTL_ENV,
+    CATALOG_POLL_ENV,
+)

@@ -262,6 +262,8 @@ _SUBSYSTEM_EXCEPTIONS = {
     "FrequencyBudgetExceeded": "deequ_tpu.analyzers.grouping",
     "MeshExhaustedError": "deequ_tpu.parallel.elastic",
     "HostLossError": "deequ_tpu.cluster.membership",
+    "CatalogError": "deequ_tpu.service.catalog",
+    "FrameQuarantinedError": "deequ_tpu.ingest.rowgate",
 }
 
 

@@ -12,6 +12,10 @@ a ~GB/s device appetite). This package is the missing frontend:
   per-frame atomic fold into a streaming session (`fold_stream`);
 - :mod:`.endpoint` — the HTTP frontend riding the MetricsExporter plane
   (``POST /ingest/v1/<tenant>/<dataset>``);
+- :mod:`.rowgate` — row-level ingest gating: one vectorized conformance
+  mask per frame BEFORE the fold, clean rows fold bit-exact, rejects go
+  to a typed, bounded, content-addressed Arrow quarantine sidecar
+  (`RowGate`, `QuarantineSidecar`);
 - :mod:`.prefetch` — the double-buffered host->device feed pipeline the
   engine's device pass pulls batches through
   (`PrefetchingBatchIterator`, ``DEEQU_TPU_PREFETCH_DEPTH``).
@@ -33,6 +37,14 @@ from .arrow_stream import (
 )
 from .columnar import as_dataset, payload_bytes
 from .endpoint import INGEST_PREFIX, IngestEndpoint
+from .rowgate import (
+    DEFAULT_QUARANTINE_MAX_ROWS,
+    QUARANTINE_MAX_ROWS_ENV,
+    FrameQuarantinedError,
+    QuarantineSidecar,
+    RowGate,
+    quarantine_max_rows,
+)
 from .prefetch import (
     DEFAULT_FEED_STALL_S,
     DEFAULT_PREFETCH_DEPTH,
@@ -52,4 +64,7 @@ __all__ = [
     "PREFETCH_DEPTH_ENV", "DEFAULT_PREFETCH_DEPTH",
     "FEED_STALL_ENV", "DEFAULT_FEED_STALL_S",
     "MalformedFrameError", "FeedDisconnectError", "FeedStallError",
+    "RowGate", "QuarantineSidecar", "FrameQuarantinedError",
+    "quarantine_max_rows", "QUARANTINE_MAX_ROWS_ENV",
+    "DEFAULT_QUARANTINE_MAX_ROWS",
 ]

@@ -64,14 +64,31 @@ def _typed_error_response(exc, metrics, labels, source) -> Tuple[int, dict]:
         return 400, {"error": "malformed_frame", "detail": str(exc)}
     if isinstance(exc, SchemaDriftError):
         return 409, {"error": "schema_drift", "detail": str(exc)}
+    from .rowgate import FrameQuarantinedError
+
+    if isinstance(exc, FrameQuarantinedError):
+        # every row of the frame failed the tenant's row-level schema:
+        # nothing folded, the frame sits in the quarantine sidecar —
+        # 422 (the payload is well-FORMED Arrow, its CONTENT is
+        # unprocessable), distinct from the 400 decode failures
+        return 422, {"error": "frame_quarantined", "detail": str(exc)}
     from ..service.errors import (
         JobFailed,
         JobTimeout,
+        QuotaExceeded,
         ServiceClosed,
         ServiceOverloaded,
         SessionClosed,
     )
 
+    # QuotaExceeded BEFORE its ServiceOverloaded parent: both are 429,
+    # but the body must tell the producer whether ITS budget or the
+    # GLOBAL queue was the limit (the remedies differ: back off vs
+    # retry-later)
+    if isinstance(exc, QuotaExceeded):
+        metrics.inc("deequ_service_ingest_shed_total", **labels)
+        return 429, {"error": "quota_exceeded", "detail": str(exc),
+                     "resource": exc.resource}
     if isinstance(exc, ServiceOverloaded):
         metrics.inc("deequ_service_ingest_shed_total", **labels)
         return 429, {"error": "overloaded", "detail": str(exc)}
@@ -123,11 +140,34 @@ class IngestEndpoint:
         tenant, dataset = target
         session = self.service.get_session(tenant, dataset,
                                            include_closed=True)
+        plane = getattr(self.service, "catalog_plane", None)
+        if session is None and plane is not None and plane.catalog.registered(
+            tenant
+        ):
+            # catalog auto-open: a REGISTERED tenant's first POST
+            # materializes its session from the catalog document (checks,
+            # gate, quotas, watches all from the declarative suite) — the
+            # cold->hot promotion of the tenant tiering. UNREGISTERED
+            # tenants keep the 404 below: the endpoint still never
+            # invents a zero-check session.
+            from ..service.catalog import CatalogError
+
+            try:
+                session = plane.ensure_session(tenant, dataset)
+            except CatalogError as exc:
+                # registered but unservable (every version corrupt, no
+                # last-good): the tenant EXISTS, the catalog is the sick
+                # part — 503 so the producer retries after the operator
+                # repairs the document, instead of a 404 baiting it into
+                # re-registering
+                return 503, {"error": "catalog_error", "tenant": tenant,
+                             "detail": str(exc)}
         if session is None:
             return 404, {"error": "unknown_session", "tenant": tenant,
                          "dataset": dataset, "detail": (
                              "create the session (with its checks) via "
-                             "service.session() before feeding it"
+                             "service.session(), or register the tenant "
+                             "in the catalog, before feeding it"
                          )}
         if session.closed:
             # "gone", not "never existed": the documented 410 contract —
@@ -135,6 +175,12 @@ class IngestEndpoint:
             # told to do that for a deliberately closed session
             return 410, {"error": "session_closed", "tenant": tenant,
                          "dataset": dataset}
+        if plane is not None:
+            # the fold-boundary hook: touch the tenant's hot-tier idle
+            # clock and (debounced) poll its document version, hot-
+            # reloading the session when the catalog was edited —
+            # tolerant of sessions the plane did not open
+            plane.on_fold_boundary(session)
         metrics = self.service.metrics
         labels = {"tenant": tenant, "dataset": dataset}
         try:

@@ -45,12 +45,19 @@ site                 fires
 ``host_heartbeat``   per host in the cluster membership scan, tag = host id
 ``ring_rebalance``   before a hash-ring host add/remove re-hashes key ranges
 ``lease_acquire``    at a compaction-lease election attempt, tag = lease path
+``catalog_load``     before a tenant catalog document parses, tag = tenant
+``row_gate``         per frame before the row-level conformance mask runs,
+                     tag = ``tenant/dataset``
 ===================  ========================================================
 
 The ``corrupt`` kind (a typed ``CorruptStateError``) injected at the three
 load sites stands in for bit rot/torn writes the checksum layer would
 detect; ``drift`` (a typed ``SchemaDriftError``) at ``stream_fold`` stands
-in for a micro-batch whose schema drifted from the session contract.
+in for a micro-batch whose schema drifted from the session contract. At
+``catalog_load`` the ``corrupt`` kind stands in for a torn/garbled tenant
+catalog document (the catalog quarantines it and keeps serving last-good);
+at ``row_gate`` it stands in for a frame the conformance mask cannot even
+be computed over (the gate surfaces it typed before anything folds).
 
 The ingest kinds: ``frame_corrupt`` (a typed ``MalformedFrameError``)
 injected at ``frame_decode`` stands in for torn/garbled Arrow IPC bytes a
@@ -197,6 +204,8 @@ KNOWN_FAULT_SITES = frozenset({
     "host_heartbeat",
     "ring_rebalance",
     "lease_acquire",
+    "catalog_load",
+    "row_gate",
 })
 
 

@@ -45,6 +45,7 @@ from ..data import Dataset
 from .errors import (
     JobFailed,
     JobTimeout,
+    QuotaExceeded,
     ServiceClosed,
     ServiceError,
     ServiceOverloaded,
@@ -52,6 +53,7 @@ from .errors import (
     TransientFailure,
 )
 from ..exceptions import SchemaDriftError
+from .catalog import CatalogError, CatalogPlane, TenantCatalog, TenantDocument
 from .coalesce import CrossoverRouter, FoldCoalescer
 from .drift import DriftReport, SchemaContract
 from .fleet import (
@@ -74,7 +76,13 @@ from .placement import (
     battery_signature,
     shape_qualified_signature,
 )
-from .scheduler import JobContext, JobHandle, JobScheduler, Priority
+from .scheduler import (
+    JobContext,
+    JobHandle,
+    JobScheduler,
+    Priority,
+    TenantQuota,
+)
 from .streaming import StreamingSession, session_key
 
 __all__ = [
@@ -91,6 +99,8 @@ __all__ = [
     "ServiceError", "ServiceOverloaded", "JobTimeout", "JobFailed",
     "TransientFailure", "SessionClosed", "ServiceClosed",
     "SchemaContract", "DriftReport", "SchemaDriftError",
+    "TenantCatalog", "TenantDocument", "CatalogPlane", "CatalogError",
+    "TenantQuota", "QuotaExceeded",
 ]
 
 
@@ -108,6 +118,7 @@ class VerificationService:
         metrics: Optional[ServiceMetrics] = None,
         fleet: Optional[bool] = None,
         partition_store=None,
+        catalog=None,
     ):
         self.metrics = metrics or ServiceMetrics()
         self.router = PlacementRouter(
@@ -182,6 +193,18 @@ class VerificationService:
         from .streaming import describe_streaming_series
 
         describe_streaming_series(self.metrics)
+        # the tenant isolation plane's declarative frontend: a catalog of
+        # per-tenant suite DOCUMENTS (checks, row gate, quotas, watches,
+        # drift/priority policy), bound to this service by a CatalogPlane
+        # that materializes sessions from documents on first ingest and
+        # hot-reloads them at fold boundaries. Accepts a TenantCatalog
+        # instance or a root path; None = no catalog (every session is
+        # constructed programmatically, exactly as before).
+        self.catalog_plane = None
+        if catalog is not None:
+            if isinstance(catalog, str):
+                catalog = TenantCatalog(catalog, metrics=self.metrics)
+            self.catalog_plane = CatalogPlane(self, catalog)
 
     # -- one-shot jobs -------------------------------------------------------
 

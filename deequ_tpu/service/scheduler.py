@@ -50,6 +50,7 @@ from ..runners.engine import RunMonitor
 from .errors import (
     JobFailed,
     JobTimeout,
+    QuotaExceeded,
     ServiceClosed,
     ServiceError,
     ServiceOverloaded,
@@ -65,6 +66,58 @@ class Priority(enum.IntEnum):
     HIGH = 0
     NORMAL = 1
     LOW = 2
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission budget — the isolation half of multi-tenancy:
+    one tenant's flood becomes ITS OWN typed :class:`QuotaExceeded` (HTTP
+    429) instead of everyone's queue latency.
+
+    ``rows_per_s`` / ``bytes_per_s`` are sustained ingest rates enforced
+    by token bucket at the streaming admission edge (before anything is
+    queued or folded); ``queue_share`` is the fraction of the scheduler's
+    ``max_queue_depth`` this tenant's pending jobs may occupy (enforced
+    inside :meth:`JobScheduler.submit`). ``None`` per field = unlimited.
+    Tenants with NO quota registered are entirely unthrottled — quotas
+    are opt-in per tenant (normally set from the tenant catalog's
+    ``quotas`` document section)."""
+
+    rows_per_s: Optional[float] = None
+    bytes_per_s: Optional[float] = None
+    queue_share: Optional[float] = None
+
+
+class _TokenBucket:
+    """Deficit token bucket on ``time.monotonic``: a charge is admitted
+    whenever the balance is non-negative and then subtracts its FULL
+    amount (the balance may go deeply negative), so any single batch size
+    is admittable and the steady-state rate still converges on ``rate`` —
+    a producer who sent a 1M-row frame simply owes the bucket ~1M/rate
+    seconds of silence. ``charge`` returns 0.0 on admission or the
+    seconds until the balance refills to zero (the caller's bounded
+    backpressure wait); a refused charge consumes NOTHING."""
+
+    __slots__ = ("rate", "burst", "tokens", "last")
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+        #: accrual cap: at most one second of idle credit, so a tenant
+        #: idle for an hour cannot burst an hour's budget in one frame
+        self.burst = float(rate)
+        self.tokens = 0.0
+        self.last: Optional[float] = None
+
+    def charge(self, amount: float, now: float) -> float:
+        if self.last is not None:
+            self.tokens = min(
+                self.tokens + (now - self.last) * self.rate, self.burst
+            )
+        self.last = now
+        if self.tokens < 0:
+            return -self.tokens / self.rate
+        self.tokens -= float(amount)
+        return 0.0
 
 
 @dataclass
@@ -213,6 +266,13 @@ class JobScheduler:
         #: coalesce keys under an ACTIVE drain: their jobs stay queued for
         #: bulk absorption instead of being picked (see _eligible)
         self._deferred: set = set()
+        #: tenant -> TenantQuota; buckets are lazily built per (tenant,
+        #: resource) and rebuilt when a quota edit changes the rate.
+        #: Guarded by _quota_lock (NOT the queue lock: charge_quota's
+        #: bounded sleeps must never park inside queue admission)
+        self._quota_lock = threading.Lock()
+        self._quotas: Dict[str, TenantQuota] = {}
+        self._buckets: Dict[Tuple[str, str], _TokenBucket] = {}
         #: harvest listeners (fn(tenant)) invoked OUTSIDE the queue lock
         #: after every job harvest — the fleet watch's re-score trigger
         #: (see service.fleetwatch). Append-only; registration races at
@@ -224,6 +284,14 @@ class JobScheduler:
         self.metrics.describe(
             "deequ_service_jobs_shed_total",
             "Jobs rejected by admission control (ServiceOverloaded).",
+        )
+        self.metrics.describe(
+            "deequ_service_quota_shed_total",
+            "Admissions refused by a PER-TENANT quota (typed "
+            "QuotaExceeded), by tenant and resource (rows_per_s / "
+            "bytes_per_s / queue_share) — distinct from global "
+            "jobs_shed_total: the tenant exceeded its OWN budget while "
+            "neighbors kept their full service.",
         )
         self.metrics.describe(
             "deequ_service_jobs_completed_total",
@@ -329,6 +397,84 @@ class JobScheduler:
                 not self._ready and not self._delayed and self._active == 0
             )
 
+    # -- tenant quotas -------------------------------------------------------
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        """Install (or replace) ``tenant``'s admission budget. Takes
+        effect on the next charge/submit; rate edits rebuild the token
+        buckets (fresh balance — a quota RAISE must not inherit an hour
+        of debt accrued under the old rate)."""
+        tenant = str(tenant)
+        with self._quota_lock:
+            self._quotas[tenant] = quota
+            for key in [k for k in self._buckets if k[0] == tenant]:
+                del self._buckets[key]
+
+    def clear_quota(self, tenant: str) -> None:
+        tenant = str(tenant)
+        with self._quota_lock:
+            self._quotas.pop(tenant, None)
+            for key in [k for k in self._buckets if k[0] == tenant]:
+                del self._buckets[key]
+
+    def get_quota(self, tenant: str) -> Optional[TenantQuota]:
+        with self._quota_lock:
+            return self._quotas.get(str(tenant))
+
+    def charge_quota(
+        self,
+        tenant: str,
+        rows: int = 0,
+        nbytes: int = 0,
+        block_s: Optional[float] = None,
+    ) -> None:
+        """Charge one ingest frame against ``tenant``'s rate quotas, or
+        refuse it typed. Called at the streaming admission edge BEFORE
+        anything queues or folds. Over-rate charges park the caller in
+        bounded backpressure for up to ``block_s`` seconds (the bucket's
+        own refill estimate paces the sleeps), then shed with
+        :class:`QuotaExceeded` — which consumes NONE of the budget, so a
+        shed flood cannot starve the tenant's own later frames. No quota
+        registered: free. Never touches the queue lock."""
+        tenant = str(tenant)
+        with self._quota_lock:
+            quota = self._quotas.get(tenant)
+        if quota is None:
+            return
+        deadline = (
+            None if not block_s else time.monotonic() + float(block_s)
+        )
+        for resource, rate, amount in (
+            ("rows_per_s", quota.rows_per_s, rows),
+            ("bytes_per_s", quota.bytes_per_s, nbytes),
+        ):
+            if not rate or amount <= 0:
+                continue
+            while True:
+                now = time.monotonic()
+                with self._quota_lock:
+                    bucket = self._buckets.get((tenant, resource))
+                    if bucket is None:
+                        bucket = _TokenBucket(float(rate))
+                        self._buckets[(tenant, resource)] = bucket
+                    wait = bucket.charge(float(amount), now)
+                    debt = -bucket.tokens
+                if wait <= 0:
+                    break
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is None or remaining <= 0:
+                    self.metrics.inc(
+                        "deequ_service_quota_shed_total",
+                        tenant=tenant, resource=resource,
+                    )
+                    raise QuotaExceeded(
+                        tenant, resource, float(rate),
+                        float(debt + amount),
+                    )
+                time.sleep(min(wait, remaining))
+
     def submit(
         self,
         fn: Callable[[JobContext], Any],
@@ -380,23 +526,59 @@ class JobScheduler:
         already committed the fold makes the job succeed with the
         committed result, and an unclaimed fold is withdrawn so no later
         drain can commit a batch whose caller was told it failed."""
+        # per-tenant queue share (quota-opted tenants only): a tenant's
+        # pending jobs may occupy at most share * max_queue_depth slots,
+        # so one tenant's backlog can fill ITS slice — never the queue
+        with self._quota_lock:
+            quota = self._quotas.get(tenant)
+        share_limit = None
+        if quota is not None and quota.queue_share:
+            share_limit = max(
+                1, int(float(quota.queue_share) * self.max_queue_depth)
+            )
         with self._cond:
             if self._closed:
                 raise ServiceClosed("verification service is shut down")
+
+            def _tenant_depth() -> int:
+                return sum(
+                    1 for _, _, j in self._ready if j.tenant == tenant
+                ) + sum(
+                    1 for _, _, j in self._delayed if j.tenant == tenant
+                )
+
             depth = len(self._ready) + len(self._delayed)
-            if depth >= self.max_queue_depth and block_s:
+            tdepth = _tenant_depth() if share_limit is not None else 0
+
+            def _blocked() -> bool:
+                return depth >= self.max_queue_depth or (
+                    share_limit is not None and tdepth >= share_limit
+                )
+
+            if _blocked() and block_s:
                 deadline = time.monotonic() + float(block_s)
-                while not self._closed and depth >= self.max_queue_depth:
+                while not self._closed and _blocked():
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
+                    # pickups free both global slots and tenant slots, so
+                    # one waiter set covers both admission gates
                     self._space.wait(remaining)
                     depth = len(self._ready) + len(self._delayed)
+                    tdepth = _tenant_depth() if share_limit is not None else 0
                 if self._closed:
                     raise ServiceClosed("verification service is shut down")
             if depth >= self.max_queue_depth:
                 self.metrics.inc("deequ_service_jobs_shed_total", tenant=tenant)
                 raise ServiceOverloaded(depth, self.max_queue_depth)
+            if share_limit is not None and tdepth >= share_limit:
+                self.metrics.inc(
+                    "deequ_service_quota_shed_total",
+                    tenant=tenant, resource="queue_share",
+                )
+                raise QuotaExceeded(
+                    tenant, "queue_share", float(share_limit), float(tdepth)
+                )
             seq = next(self._seq)
             now = time.monotonic()
             jid = job_id or f"{tenant}-{seq}"

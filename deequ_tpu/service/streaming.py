@@ -93,6 +93,11 @@ def describe_streaming_series(metrics) -> None:
     )
 
 
+#: reconfigure()'s "field not passed" sentinel (None is meaningful for
+#: row_gate: it means REMOVE the gate)
+_UNSET = object()
+
+
 def _bucket_batch_size(rows: int) -> int:
     """Micro-batch rows -> the next power of two (floor 1024): every jit
     compile is shape-specialized, so folding each arriving batch at its raw
@@ -134,6 +139,7 @@ class StreamingSession:
         keep_results: int = 256,
         drift_policy: str = "reject",
         admission_block_s: Optional[float] = None,
+        row_gate: Optional[Any] = None,
     ):
         # max_retries defaults to 0 because a fold MUTATES persisted state:
         # a transient failure in the middle of a run can leave some
@@ -165,6 +171,11 @@ class StreamingSession:
         #: typed shed (backpressure for streaming producers); None keeps
         #: the scheduler's shed-immediately default
         self.admission_block_s = admission_block_s
+        #: optional row-level gate (`deequ_tpu.ingest.rowgate.RowGate`):
+        #: every frame is conformance-masked BEFORE the fold, clean rows
+        #: fold bit-exact, rejects quarantine typed. Normally installed
+        #: from the tenant catalog's ``row_gate`` document section.
+        self.row_gate = row_gate
         from .drift import DRIFT_POLICIES
 
         if drift_policy not in DRIFT_POLICIES:
@@ -179,8 +190,13 @@ class StreamingSession:
         #: submission order (the FIFO the coalescer's drains rely on);
         #: never held during a fold
         self._submit_order = threading.Lock()
-        #: coalesce eligibility plans keyed by schema fingerprint
+        #: coalesce eligibility plans keyed by (reconfigure epoch, schema
+        #: fingerprint); the epoch makes invalidation a read-side key
+        #: change instead of a cross-lock dict clear (`_coalesce_plan`
+        #: writes under the submit lock, reconfigure() under the serial
+        #: lock — they must not share a mutable field)
         self._plans: dict = {}
+        self._plans_epoch = 0
         self._closed = False
         self._schema = None
         #: the schema promise captured from the FIRST folded batch; every
@@ -248,6 +264,27 @@ class StreamingSession:
         from ..ingest.columnar import as_dataset
 
         data = as_dataset(data)
+        # per-tenant admission budget FIRST (one dict lookup for quota-
+        # free tenants): the producer is charged for the WHOLE incoming
+        # frame — garbage rows included — before any CPU is spent masking
+        # or folding it, so an over-quota flood sheds typed (QuotaExceeded
+        # -> 429) at the cheapest possible point
+        from ..ingest.columnar import payload_bytes
+
+        self.service.scheduler.charge_quota(
+            self.tenant,
+            rows=int(data.num_rows),
+            nbytes=payload_bytes(data),
+            block_s=(
+                block_s if block_s is not None else self.admission_block_s
+            ),
+        )
+        if self.row_gate is not None:
+            # one vectorized conformance mask per frame BEFORE the fold:
+            # clean rows continue bit-exact (arrow filter of the original
+            # buffers), rejects quarantine typed; a fully-rejected frame
+            # raises FrameQuarantinedError here and nothing folds
+            data = self.row_gate.split(data, self.tenant, self.dataset)
         done: dict = {}  # per-job memo: a retried job must never re-fold
         bs = _session_batch_size(int(data.num_rows), self.batch_size)
         effective_deadline = (
@@ -545,12 +582,19 @@ class StreamingSession:
         (``None`` = serial path). Per-session memo over the coalescer's
         SHARED plan cache — same-battery fleets build one plan total."""
         schema = data.schema
+        # the epoch is read FIRST: a concurrent reconfigure() swaps the
+        # analyzer battery before bumping it, so a plan memoized under
+        # the new epoch was provably built from the new battery (a plan
+        # built mid-swap lands under the old epoch and is never read)
         fp = tuple((c.name, c.kind) for c in schema.columns)
-        if fp not in self._plans:
-            self._plans[fp] = self.service.coalescer.plan_for(
+        key = (self._plans_epoch, fp)
+        if key not in self._plans:
+            # the shared cache keys by (battery, schema) — the epoch is a
+            # session-local memo concern only
+            self._plans[key] = self.service.coalescer.plan_for(
                 self._analyzers, schema, fp
             )
-        return self._plans[fp]
+        return self._plans[key]
 
     def _guard_schema(self, data: Dataset) -> Dataset:
         """The drift guard, run under the serial lock BEFORE anything
@@ -714,6 +758,58 @@ class StreamingSession:
                     tenant=self.tenant, dataset=self.dataset,
                 )
         return result
+
+    # -- hot reconfiguration -------------------------------------------------
+
+    def reconfigure(
+        self,
+        *,
+        checks=None,
+        drift_policy: Optional[str] = None,
+        priority: Optional[Priority] = None,
+        row_gate: Any = _UNSET,
+    ) -> None:
+        """Swap the session's declarative surface IN PLACE at a fold
+        boundary — the hot-reload primitive the tenant catalog's
+        :class:`~deequ_tpu.service.catalog.CatalogPlane` drives: a catalog
+        edit re-materializes checks, drift policy, priority and row gate
+        on the live session without a restart, and without touching the
+        persisted algebraic states (analyzers shared between the old and
+        new check set keep their cumulative history; newly-required
+        analyzers start folding from their next batch).
+
+        Serialized against folds by the session lock, so every fold runs
+        under exactly ONE configuration — never a half-swapped one. Fields
+        left at their defaults are untouched (``row_gate`` uses a sentinel
+        so passing ``None`` explicitly REMOVES the gate)."""
+        with self._serial:
+            if checks is not None:
+                self.checks = list(checks)
+                from ..runners.analysis_runner import (
+                    collect_required_analyzers,
+                )
+
+                self._analyzers = collect_required_analyzers(
+                    self.checks, self.required_analyzers
+                )
+                # coalesce plans key off the analyzer battery: stale
+                # plans would drain folds with the OLD battery's program.
+                # Invalidate by epoch (the memo key carries it) — the
+                # plans dict itself belongs to the submit lock
+                self._plans_epoch += 1
+            if drift_policy is not None:
+                from .drift import DRIFT_POLICIES
+
+                if drift_policy not in DRIFT_POLICIES:
+                    raise ValueError(
+                        f"drift_policy must be one of {DRIFT_POLICIES}, "
+                        f"got {drift_policy!r}"
+                    )
+                self.drift_policy = drift_policy
+            if priority is not None:
+                self.priority = priority
+            if row_gate is not _UNSET:
+                self.row_gate = row_gate
 
     # -- state-only queries --------------------------------------------------
 

@@ -1,0 +1,365 @@
+"""Tenant isolation plane: declarative catalog, hot/cold tiering,
+last-good serving with content-addressed quarantine, admission budgets
+(ISSUE 17).
+
+The plane's contracts, pinned:
+- a corrupt catalog edit NEVER takes a tenant down — load serves the
+  last-good version and bumps the quarantine counter exactly once;
+- a valid edit becomes effective at the next fold boundary, no restart;
+- a catalog-REGISTERED tenant's first POST auto-opens its session from
+  the document; UNREGISTERED tenants keep the endpoint's 404 (the
+  endpoint still never invents a zero-check session);
+- an over-quota tenant is shed TYPED (QuotaExceeded, HTTP 429) while its
+  in-quota neighbors keep folding.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu.service import (
+    CatalogError,
+    CatalogPlane,
+    QuotaExceeded,
+    TenantCatalog,
+    TenantQuota,
+    VerificationService,
+)
+
+pytestmark = pytest.mark.catalog
+
+
+def _doc(priority="normal", max_len=3, quotas=None, watches=False):
+    doc = {
+        "checks": [{"name": "base", "constraints": [
+            {"kind": "complete", "column": "id"},
+            {"kind": "min", "column": "v", "min": 0},
+        ]}],
+        "row_gate": {"columns": [
+            {"name": "id", "type": "int", "nullable": False},
+            {"name": "s", "type": "string", "max_length": max_len},
+        ]},
+        "priority": priority,
+    }
+    if quotas is not None:
+        doc["quotas"] = quotas
+    if watches:
+        doc["watches"] = [{
+            "analyzer": {"kind": "mean", "column": "v"},
+            "strategy": {"kind": "simple_threshold", "upper_bound": 1e9},
+        }]
+    return doc
+
+
+def _frame(rows=3, start=0, s="ab"):
+    return {
+        "id": np.arange(start, start + rows),
+        "s": np.array([s] * rows),
+        "v": np.ones(rows, dtype=np.float64),
+    }
+
+
+@pytest.fixture
+def catalog(tmp_path):
+    return TenantCatalog(str(tmp_path / "catalog"))
+
+
+@pytest.fixture
+def service(catalog):
+    with VerificationService(
+        workers=2, max_queue_depth=32, background_warm=False,
+        catalog=catalog,
+    ) as svc:
+        yield svc
+
+
+class TestTenantCatalog:
+    def test_register_versions_and_load(self, catalog):
+        d1 = catalog.register("acme", _doc())
+        d2 = catalog.register("acme", _doc(priority="high"))
+        assert (d1.version, d2.version) == (1, 2)
+        assert catalog.registered("acme")
+        assert not catalog.registered("ghost")
+        assert catalog.current_version("acme") == 2
+        loaded = catalog.load("acme")
+        assert loaded.version == 2
+        assert loaded.doc["priority"] == "high"
+
+    def test_invalid_document_bounces_at_register(self, catalog):
+        with pytest.raises(CatalogError, match="constraint"):
+            catalog.register("t", {"checks": [
+                {"name": "x", "constraints": [{"kind": "no-such-kind"}]}
+            ]})
+        with pytest.raises(CatalogError):
+            catalog.register("t", {"row_gate": {"columns": [
+                {"name": "c", "type": "no-such-type"}
+            ]}})
+        # an invalid regex validates structurally but cannot BUILD —
+        # it must bounce at registration, not on the ingest path
+        with pytest.raises(CatalogError):
+            catalog.register("t", {"checks": [{"name": "x", "constraints": [
+                {"kind": "pattern", "column": "c", "pattern": "(unclosed"}
+            ]}]})
+        assert not catalog.registered("t")  # nothing was written
+
+    def test_unregistered_tenant_load_is_typed(self, catalog):
+        with pytest.raises(CatalogError, match="ghost"):
+            catalog.load("ghost")
+
+    def test_corrupt_edit_serves_last_good_quarantines_once(
+        self, catalog, tmp_path
+    ):
+        from deequ_tpu.service.metrics import ServiceMetrics
+
+        catalog.metrics = ServiceMetrics()
+        catalog.register("acme", _doc())
+        catalog.register("acme", _doc(priority="high"))
+        # a torn write lands as version 3
+        tdir = os.path.join(str(tmp_path / "catalog"), "t-acme")
+        with open(os.path.join(tdir, "v00000003.json"), "w") as fh:
+            fh.write('{"torn": tru')
+        for _ in range(3):  # repeated loads must not re-quarantine
+            loaded = catalog.load("acme")
+            assert loaded.version == 2
+            assert loaded.doc["priority"] == "high"
+        assert catalog.metrics.counter_value(
+            "deequ_service_catalog_quarantined_total", tenant="acme"
+        ) == 1
+        qdir = str(tmp_path / "catalog") + ".quarantine"
+        names = os.listdir(qdir)
+        assert len(names) == 1 and names[0].startswith("v00000003.json-")
+
+    def test_tampered_checksum_quarantined(self, catalog, tmp_path):
+        catalog.register("acme", _doc())
+        tdir = os.path.join(str(tmp_path / "catalog"), "t-acme")
+        path = os.path.join(tdir, "v00000001.json")
+        with open(path) as fh:
+            payload = json.load(fh)
+        payload["doc"]["priority"] = "high"  # edit without re-checksumming
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        with pytest.raises(CatalogError, match="no servable document"):
+            catalog.load("acme")
+
+    def test_registered_scale_is_listing_only(self, catalog):
+        """1M registered / 1k active must cost 1k tenants: registration
+        writes one file; current_version is a pure listing, no parse."""
+        for i in range(50):
+            catalog.register(f"t{i:03d}", _doc())
+        assert catalog.registered_count() == 50
+        assert sorted(catalog.tenants())[0] == "t000"
+        assert catalog.current_version("t007") == 1
+
+
+class TestCatalogPlane:
+    def test_materialize_from_document(self, service, catalog):
+        catalog.register("acme", _doc(priority="high",
+                                      quotas={"rows_per_s": 1e6}))
+        plane = service.catalog_plane
+        session = plane.ensure_session("acme", "clicks")
+        from deequ_tpu.service.scheduler import Priority
+
+        assert session.priority is Priority.HIGH
+        assert session.row_gate is not None
+        assert service.scheduler.get_quota("acme") == TenantQuota(
+            rows_per_s=1e6
+        )
+        r = session.ingest(_frame())
+        assert r.status.name == "SUCCESS"
+        assert plane.hot_count() == 1
+        assert plane.ensure_session("acme", "clicks") is session
+
+    def test_ensure_session_unregistered_is_typed(self, service):
+        with pytest.raises(CatalogError):
+            service.catalog_plane.ensure_session("ghost", "d")
+
+    def test_hot_reload_at_fold_boundary(self, service, catalog):
+        catalog.register("acme", _doc(priority="high"))
+        plane = service.catalog_plane
+        plane.poll_s = 0.0  # poll every fold boundary
+        session = plane.ensure_session("acme", "clicks")
+        session.ingest(_frame())
+        catalog.register("acme", _doc(priority="low", max_len=10))
+        plane.on_fold_boundary(session)
+        from deequ_tpu.service.scheduler import Priority
+
+        assert session.priority is Priority.LOW
+        # the new gate (max_len=10) is live: a frame the old gate would
+        # have quarantined now folds
+        session.ingest(_frame(s="longer-now", start=100))
+        assert service.metrics.counter_value(
+            "deequ_service_catalog_reloads_total", tenant="acme"
+        ) == 1
+
+    def test_corrupt_edit_keeps_live_config(self, service, catalog, tmp_path):
+        catalog.register("acme", _doc(priority="high"))
+        plane = service.catalog_plane
+        plane.poll_s = 0.0
+        session = plane.ensure_session("acme", "clicks")
+        tdir = os.path.join(catalog.path, "t-acme")
+        with open(os.path.join(tdir, "v00000002.json"), "w") as fh:
+            fh.write("not json at all")
+        plane.on_fold_boundary(session)
+        from deequ_tpu.service.scheduler import Priority
+
+        assert session.priority is Priority.HIGH  # unchanged
+        assert service.metrics.counter_value(
+            "deequ_service_catalog_reloads_total", tenant="acme"
+        ) == 0
+        assert service.metrics.counter_value(
+            "deequ_service_catalog_quarantined_total", tenant="acme"
+        ) == 1
+
+    def test_ttl_eviction_to_cold_and_rematerialization(
+        self, service, catalog
+    ):
+        catalog.register("acme", _doc())
+        plane = service.catalog_plane
+        session = plane.ensure_session("acme", "clicks")
+        session.ingest(_frame())
+        assert plane.sweep() == 0  # fresh: not idle yet
+        plane.hot_ttl_s = 0.0
+        assert plane.sweep() == 1
+        assert plane.hot_count() == 0
+        assert session.closed
+        assert catalog.registered("acme")  # cold, not gone
+        # next ensure re-materializes a fresh session from the document
+        plane.hot_ttl_s = 300.0
+        again = plane.ensure_session("acme", "clicks")
+        assert again is not session and not again.closed
+
+
+class TestAdmissionBudgets:
+    def test_over_quota_shed_typed_neighbor_unaffected(self, service):
+        service.scheduler.set_quota("hog", TenantQuota(rows_per_s=50))
+        hog = service.session("hog", "d", [])
+        neighbor = service.session("calm", "d", [])
+        with pytest.raises(QuotaExceeded) as exc_info:
+            for i in range(5):
+                hog.ingest(_frame(rows=40, start=i * 40), block_s=0.0)
+        assert exc_info.value.tenant == "hog"
+        assert exc_info.value.resource == "rows_per_s"
+        assert service.metrics.counter_value(
+            "deequ_service_quota_shed_total", tenant="hog",
+            resource="rows_per_s",
+        ) >= 1
+        for i in range(5):  # the neighbor has no quota: all 5 fold
+            neighbor.ingest(_frame(rows=40, start=i * 40))
+        assert neighbor.rows_ingested == 200
+
+    def test_quota_raise_does_not_inherit_debt(self, service):
+        service.scheduler.set_quota("t", TenantQuota(rows_per_s=10))
+        # the deficit bucket admits the first over-burst charge (going
+        # into debt) and refuses the next until the debt drains
+        service.scheduler.charge_quota("t", rows=100, block_s=0.0)
+        with pytest.raises(QuotaExceeded):
+            service.scheduler.charge_quota("t", rows=100, block_s=0.0)
+        service.scheduler.set_quota("t", TenantQuota(rows_per_s=1e6))
+        service.scheduler.charge_quota("t", rows=100, block_s=0.0)
+
+    def test_queue_share_shed_typed(self):
+        with VerificationService(
+            workers=1, max_queue_depth=8, background_warm=False,
+        ) as svc:
+            svc.scheduler.set_quota("t", TenantQuota(queue_share=0.25))
+            # stall the single worker so submissions pile up
+            import threading
+
+            gate = threading.Event()
+            svc.scheduler.submit(lambda ctx: gate.wait(10), tenant="x")
+            time.sleep(0.05)
+            svc.scheduler.submit(lambda ctx: None, tenant="t")
+            svc.scheduler.submit(lambda ctx: None, tenant="t")
+            with pytest.raises(QuotaExceeded) as exc_info:
+                svc.scheduler.submit(
+                    lambda ctx: None, tenant="t", block_s=0.0
+                )
+            assert exc_info.value.resource == "queue_share"
+            gate.set()
+
+
+class TestEndpointAutoOpen:
+    def _post(self, exporter, path, body, headers=None):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            exporter.host, exporter.port, timeout=30
+        )
+        try:
+            conn.request("POST", path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read().decode())
+        finally:
+            conn.close()
+
+    def _payload(self, rows=3, s="ab"):
+        import pyarrow as pa
+
+        from deequ_tpu.ingest import encode_ipc_stream
+
+        f = _frame(rows=rows, s=s)
+        return encode_ipc_stream(pa.table({
+            k: pa.array(v) for k, v in f.items()
+        }))
+
+    def test_registered_tenant_auto_opens(self, service, catalog):
+        catalog.register("acme", _doc())
+        exporter = service.start_exporter()
+        assert service.get_session("acme", "clicks") is None
+        status, body = self._post(
+            exporter, "/ingest/v1/acme/clicks", self._payload()
+        )
+        assert status == 200 and body["rows"] == 3
+        session = service.get_session("acme", "clicks")
+        assert session is not None and session.row_gate is not None
+
+    def test_unregistered_stays_404(self, service):
+        """The endpoint's documented contract survives the catalog: an
+        UNREGISTERED tenant is still 404, never auto-created."""
+        exporter = service.start_exporter()
+        status, body = self._post(
+            exporter, "/ingest/v1/ghost/clicks", self._payload()
+        )
+        assert status == 404 and body["error"] == "unknown_session"
+        assert service.get_session("ghost", "clicks") is None
+
+    def test_fully_rejected_frame_is_422(self, service, catalog):
+        catalog.register("acme", _doc(max_len=3))
+        exporter = service.start_exporter()
+        status, body = self._post(
+            exporter, "/ingest/v1/acme/clicks",
+            self._payload(s="way-too-long"),
+        )
+        assert status == 422 and body["error"] == "frame_quarantined"
+
+    def test_over_quota_is_429_with_resource(self, service, catalog):
+        doc = _doc(quotas={"rows_per_s": 5})
+        doc["session"] = {"admission_block_s": 0.0}
+        catalog.register("acme", doc)
+        exporter = service.start_exporter()
+        last = None
+        for i in range(4):
+            last = self._post(
+                exporter, "/ingest/v1/acme/clicks", self._payload(rows=4)
+            )
+            if last[0] == 429:
+                break
+        status, body = last
+        assert status == 429
+        assert body["error"] == "quota_exceeded"
+        assert body["resource"] == "rows_per_s"
+
+    def test_unservable_catalog_is_503(self, service, catalog, tmp_path):
+        catalog.register("acme", _doc())
+        # tamper the ONLY version: registered but nothing servable
+        tdir = os.path.join(catalog.path, "t-acme")
+        with open(os.path.join(tdir, "v00000001.json"), "w") as fh:
+            fh.write("garbage")
+        exporter = service.start_exporter()
+        status, body = self._post(
+            exporter, "/ingest/v1/acme/clicks", self._payload()
+        )
+        assert status == 503 and body["error"] == "catalog_error"

@@ -213,3 +213,49 @@ class TestFrontTierMigration:
             "deequ_service_cluster_replayed_folds_total") == 1
         front.close()
         victim.service.close()
+
+
+class TestJournalBound:
+    def test_force_flush_bounds_replay_memory(self, store_root, monkeypatch):
+        """ISSUE 17 satellite: a producer that never calls flush() must
+        not grow the replay journal one payload per fold forever — at
+        DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS the front tier force-flushes
+        the session (AFTER the fold commits) and clears it."""
+        monkeypatch.setenv("DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS", "2")
+        front = FrontTier()
+        for name in ("w0", "w1"):
+            front.add_worker(make_worker(name, store_root))
+        front.open_session("t", "events", [make_check()])
+        for i in range(5):
+            front.ingest("t", "events", batch(i))
+        # folds 2 and 4 hit the bound and flushed; only fold 5 is journaled
+        assert len(front._journal[("t", "events")]) == 1
+        assert front.metrics.counter_value(
+            "deequ_service_cluster_journal_flushes_total") == 2
+        # a host loss now replays ONE fold on top of the flushed states —
+        # and recovers all 80 rows exactly
+        victim = front.placement("t", "events")
+        front.handle_host_loss(victim)
+        survivor = front.workers[front.placement("t", "events")]
+        session = survivor.service.get_session("t", "events")
+        assert front.metrics.counter_value(
+            "deequ_service_cluster_replayed_folds_total") == 1
+        result = session.current()
+        sizes = [m.value.get() for a, m in result.metrics.items()
+                 if type(a).__name__ == "Size"]
+        assert sizes == [80.0]  # flushed states + replay = every fold
+        front.close()
+
+    def test_default_bound_via_config_reexport(self):
+        from deequ_tpu.config import CLUSTER_JOURNAL_MAX_FOLDS_ENV
+        from deequ_tpu.cluster import (
+            DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS,
+            cluster_journal_max_folds,
+        )
+
+        assert CLUSTER_JOURNAL_MAX_FOLDS_ENV == (
+            "DEEQU_TPU_CLUSTER_JOURNAL_MAX_FOLDS"
+        )
+        assert cluster_journal_max_folds() == (
+            DEFAULT_CLUSTER_JOURNAL_MAX_FOLDS
+        )

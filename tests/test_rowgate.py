@@ -1,0 +1,275 @@
+"""Row-level ingest gating: the streaming promotion of the batch
+RowLevelSchemaValidator onto the Arrow ingest path.
+
+The reference `schema/RowLevelSchemaValidatorTest.scala` scenarios run
+here against BOTH paths — the batch validator and the streaming gate —
+and every scenario must produce the identical valid/invalid split: the
+gate calls the exact conformance pass the validator uses, and this file
+pins that they can never diverge."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.data import Dataset
+from deequ_tpu.ingest import (
+    FrameQuarantinedError,
+    QuarantineSidecar,
+    RowGate,
+)
+from deequ_tpu.reliability import FaultSpec, inject
+from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+from deequ_tpu.service import VerificationService
+from deequ_tpu.service.metrics import ServiceMetrics
+
+pytestmark = pytest.mark.catalog
+
+
+def _split_via_gate(data, schema, tmp_path=None):
+    """Run one frame through a fresh RowGate; returns (accepted_dataset,
+    rejected_table_or_None). A full rejection surfaces as (None, table)."""
+    sidecar = QuarantineSidecar(str(tmp_path / "q")) if tmp_path else None
+    gate = RowGate(schema, sidecar=sidecar, metrics=ServiceMetrics())
+    try:
+        accepted = gate.split(data, "t", "d")
+    except FrameQuarantinedError:
+        accepted = None
+    rejected = sidecar.read_all("t", "d") if sidecar else None
+    return accepted, rejected
+
+
+#: the reference RowLevelSchemaValidatorTest scenarios: (columns,
+#: schema builder, expected valid count). Each runs through the batch
+#: validator AND the streaming gate, and both must agree row for row.
+_SCENARIOS = [
+    (
+        "int_cast_non_nullable",
+        {"id": ["1", "2", "not-a-number", "4", None],
+         "name": list("abcde")},
+        lambda s: s.with_int_column("id", is_nullable=False),
+        3,
+    ),
+    (
+        "int_bounds",
+        {"v": ["5", "15", "25"]},
+        lambda s: s.with_int_column("v", min_value=10, max_value=20),
+        1,
+    ),
+    (
+        "string_length_and_regex",
+        {"code": ["AB", "ABC", "ABCD", "xy", None]},
+        lambda s: s.with_string_column(
+            "code", min_length=2, max_length=3, matches="^[A-Z]+$"
+        ),
+        3,
+    ),
+    (
+        "non_nullable_string",
+        {"x": ["a", None, "b"]},
+        lambda s: s.with_string_column("x", is_nullable=False),
+        2,
+    ),
+    (
+        "decimal_precision_scale",
+        {"d": ["12.34", "123456.7", "abc"]},
+        lambda s: s.with_decimal_column("d", precision=6, scale=2),
+        1,
+    ),
+    (
+        "timestamp_mask",
+        {"ts": ["2024-01-31 10:30:00", "not a date",
+                "2024-13-99 99:99:99"]},
+        lambda s: s.with_timestamp_column("ts", mask="yyyy-MM-dd HH:mm:ss"),
+        1,
+    ),
+    (
+        "multi_column_cnf",
+        {"id": ["1", "2", "x"], "name": ["alice", "bob", "carol"]},
+        lambda s: (s.with_int_column("id", is_nullable=False)
+                   .with_string_column("name", max_length=5)),
+        2,
+    ),
+]
+
+
+class TestGateValidatorParity:
+    @pytest.mark.parametrize(
+        "name,columns,build,expected_valid",
+        _SCENARIOS, ids=[s[0] for s in _SCENARIOS],
+    )
+    def test_identical_verdicts(
+        self, name, columns, build, expected_valid, tmp_path
+    ):
+        data = Dataset.from_dict(columns)
+        schema = build(RowLevelSchema())
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == expected_valid
+
+        accepted, rejected = _split_via_gate(data, schema, tmp_path)
+        gate_valid = 0 if accepted is None else accepted.num_rows
+        gate_invalid = 0 if rejected is None else rejected.num_rows
+        assert gate_valid == result.num_valid_rows
+        assert gate_invalid == result.num_invalid_rows
+
+    def test_cast_semantics_differ_by_design(self):
+        """The VALIDATOR casts its valid side (string "1" becomes int 1,
+        the reference's `castColumn`); the GATE keeps the original Arrow
+        buffers untouched so clean rows fold bit-exact. Same verdicts,
+        different output types — pinned so nobody 'fixes' one into the
+        other."""
+        data = Dataset.from_dict({"id": ["1", "2", "x"]})
+        schema = RowLevelSchema().with_int_column("id")
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert list(result.valid_rows.to_pandas()["id"]) == [1, 2]
+
+        accepted, _ = _split_via_gate(data, schema)
+        assert accepted.arrow.column("id").to_pylist() == ["1", "2"]
+        assert pa.types.is_string(
+            accepted.arrow.schema.field("id").type
+        ) or pa.types.is_large_string(accepted.arrow.schema.field("id").type)
+
+
+class TestRowGate:
+    def _schema(self):
+        return (RowLevelSchema()
+                .with_int_column("id", is_nullable=False)
+                .with_string_column("s", max_length=3))
+
+    def test_all_conforming_is_zero_copy_passthrough(self):
+        data = Dataset.from_dict({"id": ["1", "2"], "s": ["ab", "cd"]})
+        gate = RowGate(self._schema(), metrics=ServiceMetrics())
+        assert gate.split(data, "t", "d") is data
+
+    def test_quarantine_decodes_back_to_exact_rejects(self, tmp_path):
+        data = Dataset.from_dict({
+            "id": ["1", "nope", "3", "4"],
+            "s": ["ok", "ok", "way-too-long", "ok"],
+        })
+        accepted, rejected = _split_via_gate(data, self._schema(), tmp_path)
+        assert accepted.num_rows == 2
+        assert accepted.arrow.column("id").to_pylist() == ["1", "4"]
+        assert rejected.num_rows == 2
+        assert sorted(rejected.column("id").to_pylist()) == ["3", "nope"]
+        assert sorted(rejected.column("s").to_pylist()) == [
+            "ok", "way-too-long"
+        ]
+
+    def test_full_rejection_raises_typed_and_counts(self, tmp_path):
+        metrics = ServiceMetrics()
+        sidecar = QuarantineSidecar(str(tmp_path / "q"))
+        gate = RowGate(self._schema(), sidecar=sidecar, metrics=metrics)
+        data = Dataset.from_dict({"id": ["x", "y"], "s": ["ab", "cd"]})
+        with pytest.raises(FrameQuarantinedError) as exc_info:
+            gate.split(data, "t", "d")
+        assert exc_info.value.tenant == "t"
+        assert exc_info.value.rows == 2
+        assert metrics.counter_value(
+            "deequ_service_rowgate_quarantined_frames_total",
+            tenant="t", dataset="d",
+        ) == 1
+        assert sidecar.read_all("t", "d").num_rows == 2
+
+    def test_quarantine_budget_drops_counted(self, tmp_path):
+        metrics = ServiceMetrics()
+        sidecar = QuarantineSidecar(str(tmp_path / "q"), max_rows=3)
+        gate = RowGate(self._schema(), sidecar=sidecar, metrics=metrics)
+        data = Dataset.from_dict({
+            "id": ["bad"] * 5 + ["1"],
+            "s": ["x"] * 6,
+        })
+        accepted = gate.split(data, "t", "d")
+        assert accepted.num_rows == 1
+        assert sidecar.rows_written == 3 and sidecar.rows_dropped == 2
+        assert sidecar.read_all("t", "d").num_rows == 3
+        assert metrics.counter_value(
+            "deequ_service_rowgate_quarantine_dropped_rows_total",
+            tenant="t", dataset="d",
+        ) == 2
+        assert metrics.counter_value(
+            "deequ_service_rowgate_rejected_rows_total",
+            tenant="t", dataset="d",
+        ) == 5  # dropped rows still COUNT as rejected
+
+    def test_row_gate_fault_site(self):
+        gate = RowGate(self._schema(), metrics=ServiceMetrics())
+        data = Dataset.from_dict({"id": ["1"], "s": ["ab"]})
+        from deequ_tpu.exceptions import MetricCalculationRuntimeException
+
+        with inject(FaultSpec("row_gate", "corrupt", at=1)) as inj:
+            with pytest.raises(MetricCalculationRuntimeException):
+                gate.split(data, "t", "d")
+        assert inj.fired == ["row_gate:t/d:corrupt"]
+
+    def test_gated_fold_bit_exact_with_prefiltered(self):
+        """Folding the gate's accept side must equal folding a
+        pre-filtered copy of the stream, metric for metric — the accept
+        side is an Arrow filter of the ORIGINAL buffers, no pandas hop,
+        no cast."""
+        rng = np.random.default_rng(7)
+        ids = np.arange(600)
+        vals = rng.normal(10.0, 2.0, size=600)
+        good = ids % 3 != 0  # a third of rows nonconforming (id < 0 gate)
+        gated_ids = np.where(good, ids, -ids - 1)
+        checks = [Check(CheckLevel.ERROR, "c")
+                  .has_size(lambda n: n > 0)
+                  .has_mean("v", lambda m: True)
+                  .has_sum("v", lambda s: True)]
+        schema = RowLevelSchema().with_int_column("id", min_value=0)
+        gate = RowGate(schema, metrics=ServiceMetrics())
+        with VerificationService(workers=2, background_warm=False) as svc:
+            gated = svc.session("t", "gated", checks, row_gate=gate)
+            plain = svc.session("t", "plain", checks)
+            for lo in range(0, 600, 200):
+                sl = slice(lo, lo + 200)
+                gated.ingest({"id": gated_ids[sl], "v": vals[sl]})
+                keep = good[sl]
+                plain.ingest({
+                    "id": gated_ids[sl][keep], "v": vals[sl][keep]
+                })
+            rg = gated.current()
+            rp = plain.current()
+            mg = {(a.name, a.instance): m.value.get()
+                  for a, m in rg.metrics.items() if m.value.is_success}
+            mp = {(a.name, a.instance): m.value.get()
+                  for a, m in rp.metrics.items() if m.value.is_success}
+            assert mg == mp  # bit-exact, not approx
+            assert gated.rows_ingested == int(good.sum())
+
+
+class TestSessionIntegration:
+    def test_partial_reject_folds_clean_rows(self, tmp_path):
+        schema = RowLevelSchema().with_int_column("id", min_value=0)
+        with VerificationService(workers=2, background_warm=False) as svc:
+            gate = RowGate(
+                schema,
+                sidecar=QuarantineSidecar(str(tmp_path / "q")),
+                metrics=svc.metrics,
+            )
+            session = svc.session(
+                "t", "d",
+                [Check(CheckLevel.ERROR, "c").has_size(lambda n: n > 0)],
+                row_gate=gate,
+            )
+            session.ingest({"id": np.array([1, -2, 3, -4, 5])})
+            assert session.rows_ingested == 3
+            assert svc.metrics.counter_value(
+                "deequ_service_rowgate_rejected_rows_total",
+                tenant="t", dataset="d",
+            ) == 2
+            q = gate.sidecar.read_all("t", "d")
+            assert q.column("id").to_pylist() == [-2, -4]
+
+    def test_reconfigure_swaps_gate_live(self):
+        schema_strict = RowLevelSchema().with_int_column("id", min_value=0)
+        with VerificationService(workers=2, background_warm=False) as svc:
+            session = svc.session(
+                "t", "d",
+                [Check(CheckLevel.ERROR, "c").has_size(lambda n: n > 0)],
+                row_gate=RowGate(schema_strict, metrics=svc.metrics),
+            )
+            with pytest.raises(FrameQuarantinedError):
+                session.ingest({"id": np.array([-1, -2])})
+            session.reconfigure(row_gate=None)  # explicit removal
+            session.ingest({"id": np.array([-1, -2])})
+            assert session.rows_ingested == 2

@@ -83,6 +83,11 @@ _SCALARS: List[Tuple[str, str, str]] = [
     # fleet watch (ISSUE 15): the per-harvest batched scoring rate must
     # not rot (higher-better)
     ("anomaly_fleet", "anomaly_fleet_series_per_s", "throughput"),
+    # tenant isolation plane (ISSUE 17): hot-tier fold rate and the row
+    # gate's steady-state cost (gated/ungated MB/s; floor 0.8 enforced in
+    # the stage, drift gated here) must not rot
+    ("catalog_soak", "catalog_soak_sessions_per_s", "throughput"),
+    ("catalog_soak", "gated_throughput_fraction", "throughput"),
 ]
 
 

@@ -182,6 +182,8 @@ def run_soak(
             summary["ingest_drill"] = _ingest_drill(service)
             summary["coalesce_drill"] = _coalesce_drill(service)
             summary["fleet_drill"] = _fleet_drill()
+            summary["catalog_drill"] = _catalog_drill()
+            summary["row_gate_drill"] = _row_gate_drill(service)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -209,6 +211,8 @@ def run_soak(
         "ingest_drill": summary["ingest_drill"]["ok"],
         "coalesce_drill": summary["coalesce_drill"]["ok"],
         "fleet_drill": summary["fleet_drill"]["ok"],
+        "catalog_drill": summary["catalog_drill"]["ok"],
+        "row_gate_drill": summary["row_gate_drill"]["ok"],
     }
     if "cluster_drill" in summary:
         invariants["cluster_drill"] = summary["cluster_drill"]["ok"]
@@ -460,6 +464,186 @@ def _fleet_drill() -> Dict:
         and (out["shed"] or 0) == 0
         and all(committed[t] == batches for t in tenants)
         and all(parity.values())
+    )
+    return out
+
+
+def _catalog_drill() -> Dict:
+    """Tenant-catalog corruption drill (ISSUE 17): a catalog-driven
+    session takes (1) a REAL torn write as its tenant's newest document
+    version and (2) an injected ``catalog_load`` corrupt fault on a
+    freshly registered good version. Both must degrade to LAST-GOOD —
+    the session keeps folding under its live config, each bad version is
+    quarantined content-addressed with EXACTLY one counter bump (the
+    move semantics: repeated fold boundaries never re-walk a quarantined
+    version) — and the plane must still hot-reload a subsequent GOOD
+    edit without restart. ``inject()`` swaps the soak's ambient plan out
+    so an ambient hit cannot shift the pinned counts."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from deequ_tpu.reliability import FaultSpec, inject
+    from deequ_tpu.service import TenantCatalog, VerificationService
+    from deequ_tpu.service.scheduler import Priority
+
+    def doc(priority="normal"):
+        return {
+            "checks": [{"name": "drill", "constraints": [
+                {"kind": "complete", "column": "id"},
+                {"kind": "size", "min": 1},
+            ]}],
+            "row_gate": {"columns": [
+                {"name": "id", "type": "int", "nullable": False},
+            ]},
+            "priority": priority,
+        }
+
+    def frame(start=0, rows=256):
+        return {"id": np.arange(start, start + rows)}
+
+    out: Dict = {}
+    root = tempfile.mkdtemp(prefix="chaos-catalog-")
+    with inject():
+        catalog = TenantCatalog(os.path.join(root, "catalog"))
+        catalog.register("drill", doc())
+        with VerificationService(
+            workers=2, background_warm=False, catalog=catalog,
+        ) as svc:
+            plane = svc.catalog_plane
+            plane.poll_s = 0.0  # every boundary polls: no debounce waits
+            session = plane.ensure_session("drill", "stream")
+            ok0 = session.ingest(frame(0)).status.name == "SUCCESS"
+
+            # (1) real torn write lands as the newest version
+            torn = os.path.join(
+                catalog.path, "t-drill", "v00000077.json"
+            )
+            with open(torn, "w") as fh:
+                fh.write('{"torn": tru')
+            for _ in range(3):  # repeated boundaries: ONE bump, not 3
+                plane.on_fold_boundary(session)
+            ok1 = session.ingest(frame(256)).status.name == "SUCCESS"
+            torn_bumps = svc.metrics.counter_value(
+                "deequ_service_catalog_quarantined_total", tenant="drill"
+            )
+
+            # (2) injected corrupt on a GOOD new version: quarantined
+            # like the real thing, the previous version keeps serving
+            catalog.register("drill", doc(priority="high"))
+            with inject(
+                FaultSpec("catalog_load", "corrupt", at=1)
+            ) as inj:
+                plane.on_fold_boundary(session)
+            ok2 = session.ingest(frame(512)).status.name == "SUCCESS"
+            injected_bumps = svc.metrics.counter_value(
+                "deequ_service_catalog_quarantined_total", tenant="drill"
+            ) - torn_bumps
+
+            # (3) the NEXT good edit still hot-reloads — corruption must
+            # not wedge the reload path
+            catalog.register("drill", doc(priority="low"))
+            plane.on_fold_boundary(session)
+            ok3 = session.ingest(frame(768)).status.name == "SUCCESS"
+            out.update({
+                "folds_ok": [ok0, ok1, ok2, ok3],
+                "torn_bumps": torn_bumps,
+                "injected_fired": len(inj.fired),
+                "injected_bumps": injected_bumps,
+                "quarantine_files": sorted(
+                    os.listdir(catalog.path + ".quarantine")
+                ),
+                "priority_after": session.priority.name,
+            })
+    out["ok"] = (
+        all(out["folds_ok"])
+        and out["torn_bumps"] == 1
+        and out["injected_fired"] == 1 and out["injected_bumps"] == 1
+        and len(out["quarantine_files"]) == 2
+        and out["priority_after"] == Priority.LOW.name
+    )
+    return out
+
+
+def _row_gate_drill(service) -> Dict:
+    """Row-gate drill, run inside the soak against the live service: a
+    gated session takes (1) an injected ``row_gate`` corrupt fault — a
+    frame whose conformance mask cannot be computed — which must surface
+    TYPED with NOTHING folded, and the session must keep folding after;
+    (2) a real partial-garbage frame whose clean rows fold while the
+    rejects land decodable in the typed quarantine sidecar; (3) an
+    all-garbage frame which must raise typed ``FrameQuarantinedError``
+    with nothing folded. ``inject`` swaps the soak's ambient plan out so
+    an ambient hit cannot shift the pinned fold counts."""
+    import tempfile
+
+    import numpy as np
+
+    from deequ_tpu.exceptions import MetricCalculationRuntimeException
+    from deequ_tpu.ingest import (
+        FrameQuarantinedError,
+        QuarantineSidecar,
+        RowGate,
+    )
+    from deequ_tpu.reliability import FaultSpec, inject
+    from deequ_tpu.schema import RowLevelSchema
+
+    from deequ_tpu.checks import Check, CheckLevel
+
+    checks = [Check(CheckLevel.ERROR, "row-gate drill")
+              .has_size(lambda n: n > 0).is_complete("id")]
+    schema = RowLevelSchema().with_int_column("id", is_nullable=False)
+    sidecar = QuarantineSidecar(
+        tempfile.mkdtemp(prefix="chaos-rowgate-")
+    )
+    gate = RowGate(schema, sidecar=sidecar, metrics=service.metrics)
+    out: Dict = {}
+    with inject():
+        session = service.session(
+            "rowgate-drill", "stream", checks, row_gate=gate,
+        )
+        # (1) injected corrupt: typed, nothing folds, session survives
+        with inject(FaultSpec("row_gate", "corrupt", at=1)) as inj:
+            try:
+                session.ingest({"id": np.arange(64)})
+                out["injected_typed"] = False
+            except MetricCalculationRuntimeException:
+                out["injected_typed"] = True
+        out["injected_fired"] = len(inj.fired)
+        out["committed_after_fault"] = session.batches_ingested
+
+        # (2) partial garbage: nulls in a non-nullable column reject;
+        # the clean rows fold and the rejects decode back exactly
+        mixed = {"id": np.array([1.0, np.nan, 3.0, np.nan, 5.0])}
+        r = session.ingest(mixed)
+        out["partial_status"] = r.status.name
+        out["committed_after_partial"] = session.batches_ingested
+        quarantined = sidecar.read_all("rowgate-drill", "stream")
+        out["quarantined_rows"] = (
+            int(quarantined.num_rows) if quarantined is not None else 0
+        )
+
+        # (3) full garbage: typed FrameQuarantinedError, nothing folds
+        try:
+            session.ingest({"id": np.array([np.nan, np.nan])})
+            out["full_reject_typed"] = False
+        except FrameQuarantinedError:
+            out["full_reject_typed"] = True
+        out["committed_final"] = session.batches_ingested
+        out["rejected_counter"] = service.metrics.counter_value(
+            "deequ_service_rowgate_rejected_rows_total",
+            tenant="rowgate-drill", dataset="stream",
+        )
+    out["ok"] = (
+        out["injected_typed"] and out["injected_fired"] == 1
+        and out["committed_after_fault"] == 0
+        and out["partial_status"] == "SUCCESS"
+        and out["committed_after_partial"] == 1
+        and out["quarantined_rows"] == 2
+        and out["full_reject_typed"]
+        and out["committed_final"] == 1
+        and out["rejected_counter"] == 4
     )
     return out
 
