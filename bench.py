@@ -1149,6 +1149,82 @@ def run_streaming_knee_stage() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# stage 2d': self-tuning calibration (ISSUE 18 acceptance) — the boot-time
+# calibrator measured end to end, then the SAME streaming+grouping point
+# static vs tuned; bench_diff gates tuned >= static within the band
+# ---------------------------------------------------------------------------
+
+
+def run_calibration_stage() -> dict:
+    """Run ``deequ_tpu.tuning.calibrate`` fresh in a DETACHED subprocess
+    against a throwaway profile dir (probe values + derived knobs + wall
+    time land in the partial JSON), then measure one streaming+grouping
+    throughput point twice in two more detached service processes:
+    STATIC (``DEEQU_TPU_AUTOTUNE=0``) and TUNED (the freshly calibrated
+    profile loaded at service boot). Each point starts from a cold
+    interpreter so neither arm inherits the other's compiled programs or
+    router EWMAs. bench_diff gates tuned >= static within the band."""
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+
+    t0 = time.perf_counter()
+    here = os.path.dirname(os.path.abspath(__file__))
+    profile_dir = tempfile.mkdtemp(prefix="bench-tuning-profile-")
+    base_env = dict(os.environ)
+    base_env["DEEQU_TPU_TUNING_PROFILE_DIR"] = profile_dir
+
+    def detached(module_args: list, extra_env: dict, label: str) -> dict:
+        env = dict(base_env)
+        env.update(extra_env)
+        proc = subprocess.run(
+            [sys.executable, "-m"] + module_args,
+            cwd=here, capture_output=True, text=True,
+            timeout=subprocess_timeout_s(), env=env,
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"calibration {label} subprocess rc={proc.returncode}: "
+                f"{proc.stderr[-500:]}"
+            )
+        return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cal = detached(["deequ_tpu.tuning.calibrate", "--json"], {}, "probe")
+    log(
+        f"[calibration] {len(cal['probes'])} probes in "
+        f"{cal['wall_s']:.2f}s on substrate {cal['fingerprint']}: "
+        f"device_fixed {cal['probes']['device_fixed_s'] * 1e3:.2f}ms, "
+        f"device {cal['probes']['device_rows_per_s'] / 1e6:.0f}M rows/s, "
+        f"group host/device "
+        f"{cal['probes']['group_host_rows_per_s'] / 1e6:.1f}M/"
+        f"{cal['probes']['group_device_rows_per_s'] / 1e6:.1f}M rows/s"
+    )
+    static = detached(["tools.tuning_report", "--bench-point"],
+                      {"DEEQU_TPU_AUTOTUNE": "0"}, "static-point")
+    tuned = detached(["tools.tuning_report", "--bench-point"], {},
+                     "tuned-point")
+    log(
+        f"[calibration] streaming {static['sessions_per_s']:.0f} static -> "
+        f"{tuned['sessions_per_s']:.0f} tuned sessions/s "
+        f"({tuned['sessions_per_s'] / static['sessions_per_s']:.2f}x); "
+        f"grouping {static['grouping_rows_per_s'] / 1e6:.1f}M static -> "
+        f"{tuned['grouping_rows_per_s'] / 1e6:.1f}M tuned rows/s "
+        f"({tuned['grouping_rows_per_s'] / static['grouping_rows_per_s']:.2f}x); "
+        f"tuned knobs: {', '.join(tuned['tuned_knobs']) or 'none'}"
+    )
+    return {
+        "wall_s": cal["wall_s"],
+        "fingerprint": cal["fingerprint"],
+        "probes": cal["probes"],
+        "knobs": cal["knobs"],
+        "static": static,
+        "tuned": tuned,
+        "stage_seconds": time.perf_counter() - t0,
+    }
+
+
+# ---------------------------------------------------------------------------
 # stage 2e: anomaly fleet (ISSUE 15 acceptance) — the fleet watch's
 # per-harvest scoring core: 10k tenants' metric histories, serial vs ONE
 # batched detect_batch call, parity-gated
@@ -2022,6 +2098,35 @@ def main() -> None:
                 for p in knee["points"]
             ],
             "parity_bit_exact": knee["parity"]["bit_exact"],
+        })
+
+    calibration = staged(
+        "calibration", run_calibration_stage,
+        # three detached children (probe + two measured points), each with
+        # its own interpreter startup
+        budget_s=3 * subprocess_timeout_s() + 30,
+    )
+    if calibration is not None:
+        out["calibration_wall_s"] = round(calibration["wall_s"], 2)
+        out["tuning_streaming_sessions_per_s_static"] = round(
+            calibration["static"]["sessions_per_s"], 1
+        )
+        out["tuning_streaming_sessions_per_s_tuned"] = round(
+            calibration["tuned"]["sessions_per_s"], 1
+        )
+        out["tuning_grouping_rows_per_s_static"] = round(
+            calibration["static"]["grouping_rows_per_s"], 1
+        )
+        out["tuning_grouping_rows_per_s_tuned"] = round(
+            calibration["tuned"]["grouping_rows_per_s"], 1
+        )
+        checkpoint("calibration", extra={
+            "fingerprint": calibration["fingerprint"],
+            "probes": {
+                k: round(v, 6) for k, v in calibration["probes"].items()
+            },
+            "knobs": calibration["knobs"],
+            "tuned_knobs": calibration["tuned"]["tuned_knobs"],
         })
 
     anomaly_fleet = staged(

@@ -586,29 +586,26 @@ FREQ_HOST_ROUTE_ENV = "DEEQU_TPU_FREQ_HOST_ROUTE"
 _ENV_WARNED: set = set()
 
 
-def _env_int(env: str, default: int) -> int:
-    """Validated positive-int env knob: unparseable or non-positive values
-    warn ONCE and fall back to the default instead of crashing every pass
-    (the shared `utils.env_number` helper; the DEEQU_TPU_SCAN_DEADLINE_S /
-    DEEQU_TPU_TRACE precedent)."""
-    from ..utils import env_number
-
-    return env_number(env, default, int, minimum=1)
-
-
 def device_freq_max_cardinality() -> int:
-    """The dense dictionary-path cardinality ceiling, env-overridable."""
-    return _env_int(DEVICE_FREQ_MAX_CARDINALITY_ENV, DEVICE_FREQ_MAX_CARDINALITY)
+    """The dense dictionary-path cardinality ceiling (registry-resolved:
+    env override > tuned > static)."""
+    from ..tuning import knobs
+
+    return knobs.value("device_freq_max_cardinality")
 
 
 def freq_table_slots() -> int:
     """Configured distinct-group capacity of the device frequency table."""
-    return _env_int(FREQ_TABLE_SLOTS_ENV, DEFAULT_FREQ_TABLE_SLOTS)
+    from ..tuning import knobs
+
+    return knobs.value("freq_table_slots")
 
 
 def freq_buffer_entries() -> int:
     """Configured raw-key buffer cap (the resident-mode ceiling)."""
-    return _env_int(FREQ_BUFFER_ENTRIES_ENV, DEFAULT_FREQ_BUFFER_ENTRIES)
+    from ..tuning import knobs
+
+    return knobs.value("freq_buffer_entries")
 
 
 def device_freq_enabled() -> bool:
@@ -1011,22 +1008,19 @@ def _next_pow2(v: int) -> int:
     return p
 
 
-#: union-distinct ceiling for confidently routing a grouping set to the
-#: host group-by instead of the device table (~the PERF.md knee / 4: below
-#: ~100k distinct the host value_counts fast path wins ~3x, above it the
-#: device table wins up to ~13x, so the probe only answers "host" on
-#: strong low-cardinality evidence)
-_FREQ_HOST_ROUTE_MAX_DISTINCT = 1 << 15
-_FREQ_PROBE_ROWS = 1 << 16
-#: below this row count the probe never routes host: the absolute cost of
-#: either engine is negligible at small n, so tiny runs keep the device
-#: table (and its test coverage) — the host/device rows-per-second gap
-#: only buys wall-clock at scale
-_FREQ_HOST_ROUTE_MIN_ROWS = 1 << 21
+# The probe's thresholds — the union-distinct ceiling for confidently
+# routing host (~the PERF.md knee / 4: below ~100k distinct the host
+# value_counts fast path wins ~3x, above it the device table wins up to
+# ~13x), the rows per probe slice, and the row floor below which the
+# probe never answers host — are registered tuning knobs
+# (freq_host_route_max_distinct / freq_probe_rows /
+# freq_host_route_min_rows in tuning/knobs.py) carrying the measured
+# dev-box values as static defaults; boot-time calibration re-derives
+# them per substrate.
 
 
 def probably_low_cardinality(
-    data, columns: Sequence[str], limit: int = _FREQ_HOST_ROUTE_MAX_DISTINCT
+    data, columns: Sequence[str], limit: Optional[int] = None
 ) -> bool:
     """Cheap pre-routing probe: True when EVERY column of the grouping set
     confidently looks low-cardinality, so the host group-by's
@@ -1056,8 +1050,13 @@ def probably_low_cardinality(
         raw = None
     if raw == "0":
         return False
+    from ..tuning import knobs
+
+    if limit is None:
+        limit = knobs.value("freq_host_route_max_distinct")
+    probe_rows = knobs.value("freq_probe_rows")
     n = int(data.num_rows)
-    if n <= _FREQ_HOST_ROUTE_MIN_ROWS:
+    if n <= knobs.value("freq_host_route_min_rows"):
         return False
     estimate = 1
     for col in columns:
@@ -1069,11 +1068,11 @@ def probably_low_cardinality(
                 column = data.arrow.column(col)
                 # disjoint head/mid/tail slices (n > MIN_ROWS >> 3 probes)
                 slices = [
-                    column.slice(start, _FREQ_PROBE_ROWS)
+                    column.slice(start, probe_rows)
                     for start in (
                         0,
-                        (n - _FREQ_PROBE_ROWS) // 2,
-                        n - _FREQ_PROBE_ROWS,
+                        (n - probe_rows) // 2,
+                        n - probe_rows,
                     )
                 ]
                 per_slice = [pc.count_distinct(s).as_py() for s in slices]

@@ -403,3 +403,53 @@ from .service.catalog import (  # noqa: E402,F401
     CATALOG_HOT_TTL_ENV,
     CATALOG_POLL_ENV,
 )
+
+# ---------------------------------------------------------------------------
+# Self-tuning control plane (deequ_tpu.tuning: boot-time calibration,
+# per-substrate profiles, online shadow-route re-fitting)
+# ---------------------------------------------------------------------------
+#
+# - DEEQU_TPU_AUTOTUNE: "0" disables the whole tuning plane — no profile
+#   load at service start, no online controller, and every registered
+#   knob resolves to its static default, byte-for-byte the untuned
+#   routing behavior (the escape hatch; pinned by tests/test_tuning.py).
+#   Default on.
+# - DEEQU_TPU_TUNING_PROFILE_DIR: directory holding the checksummed
+#   per-substrate calibration profiles (default: a deequ_tpu_tuning
+#   directory beside the DEEQU_TPU_COMPILE_CACHE XLA cache). One file
+#   per substrate fingerprint; corrupt or stale files are quarantined
+#   into .quarantine/ and the service boots on static defaults.
+# - DEEQU_TPU_TUNING_SHADOW_FRACTION: fraction of eligible folds the
+#   online controller routes under a CANDIDATE knob setting while an
+#   experiment runs (default 0.05; clamped to [0, 0.5] — the incumbent
+#   always keeps majority traffic; 0 starves candidates of evidence, so
+#   nothing is ever promoted).
+# - DEEQU_TPU_TUNING_MIN_SAMPLES: measured folds each experiment arm
+#   needs before a promotion/demotion verdict (default 32; minimum 1).
+# - DEEQU_TPU_TUNING_BAND: the bench_diff-style tolerance band — a
+#   candidate promotes only when its measured rows/s beats the incumbent
+#   by MORE than this fraction, and the floor guardrail demotes tuned
+#   knobs when the live rate falls this far below the measured
+#   static-default rate (default 0.25, the bench_diff CI tolerance).
+#
+# Every tunable routing constant (fast-path ceiling, coalesce width,
+# fleet sharding floor, prefetch depth, frequency-engine capacities, the
+# probably_low_cardinality probe thresholds, the CrossoverRouter cost
+# seeds) is registered in deequ_tpu/tuning/knobs.py; the env vars above
+# and each knob's own DEEQU_TPU_* override parse via the shared
+# warn-once utils.env_* readers, and operator env ALWAYS outranks tuned
+# values. New DEEQU_TPU_FREQ_* overrides registered there:
+#
+# - DEEQU_TPU_FREQ_HOST_ROUTE_MAX_DISTINCT: union-distinct ceiling for
+#   probably_low_cardinality to answer "host" (default 32768; min 1).
+# - DEEQU_TPU_FREQ_PROBE_ROWS: rows per head/mid/tail probe slice
+#   (default 65536; minimum 1).
+# - DEEQU_TPU_FREQ_HOST_ROUTE_MIN_ROWS: row floor below which the probe
+#   never routes host (default 2097152; minimum 0).
+from .tuning.knobs import (  # noqa: E402,F401
+    AUTOTUNE_ENV,
+    TUNING_BAND_ENV,
+    TUNING_MIN_SAMPLES_ENV,
+    TUNING_PROFILE_DIR_ENV,
+    TUNING_SHADOW_FRACTION_ENV,
+)

@@ -48,11 +48,11 @@ FEED_STALL_ENV = "DEEQU_TPU_FEED_STALL_S"
 DEFAULT_FEED_STALL_S = 120.0
 
 def prefetch_depth() -> int:
-    """The configured pipeline depth; warn-and-fallback on bad values."""
-    from ..utils import env_number
+    """The configured pipeline depth (env override > tuned > static 2);
+    warn-and-fallback on bad values."""
+    from ..tuning import knobs
 
-    return env_number(PREFETCH_DEPTH_ENV, DEFAULT_PREFETCH_DEPTH, int,
-                      minimum=0)
+    return knobs.value("prefetch_depth")
 
 
 def feed_stall_s() -> float:

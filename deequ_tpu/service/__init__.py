@@ -178,6 +178,15 @@ class VerificationService:
         #: harvest_now() always works)
         self.fleetwatch = FleetWatch(self)
         self.fleetwatch.attach()
+        from ..tuning import bootstrap_service
+
+        #: the self-tuning control plane: loads this substrate's
+        #: calibration profile (quarantining corrupt ones, never failing
+        #: the boot), reseeds the CrossoverRouter, and runs the online
+        #: shadow-route controller off the scheduler's harvest tick.
+        #: None when DEEQU_TPU_AUTOTUNE=0 — every knob then reads its
+        #: static default, byte-for-byte the untuned service.
+        self.tuning_controller = bootstrap_service(self)
         self._sessions: Dict[Tuple[str, str], StreamingSession] = {}
         self._sessions_lock = threading.Lock()
         self._exporter: Optional[MetricsExporter] = None

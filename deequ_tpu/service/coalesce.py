@@ -82,11 +82,12 @@ def coalesce_enabled() -> bool:
 
 
 def coalesce_max_width() -> int:
-    from ..utils import env_number
+    # registry-resolved (env override > tuned > static 16): the boot
+    # profile and the online controller can move the stack width, the
+    # operator env still always wins
+    from ..tuning import knobs
 
-    return env_number(
-        COALESCE_MAX_WIDTH_ENV, DEFAULT_COALESCE_MAX_WIDTH, int, minimum=1
-    )
+    return knobs.value("coalesce_max_width")
 
 
 #: fast-route drains may run far wider than a device stack: they execute
@@ -100,9 +101,9 @@ _FAST_DRAIN_WIDTH = 512
 
 
 def fast_path_max_rows() -> int:
-    from ..utils import env_number
+    from ..tuning import knobs
 
-    return env_number(FAST_PATH_MAX_ROWS_ENV, -1, int, minimum=-1)
+    return knobs.value("fast_path_max_rows")
 
 
 class CrossoverRouter:
@@ -120,7 +121,11 @@ class CrossoverRouter:
 
     #: seed rows/s per analyzer class before any measurement (native block
     #: kernels measure 30-200M rows/s; seeding LOW biases early folds to
-    #: the device path only for very large batches, which is safe)
+    #: the device path only for very large batches, which is safe). These
+    #: class attributes mirror the registry's static defaults
+    #: (tuning/knobs.py); live seeds resolve through the registry so a
+    #: calibration profile replaces them with this substrate's measured
+    #: rates.
     DEFAULT_HOST_ROWS_PER_S = 20e6
     #: seed device fixed seconds (PR 9 measured ~50ms/fold end to end; the
     #: launch+fetch core of it is what this models)
@@ -131,7 +136,26 @@ class CrossoverRouter:
     def __init__(self):
         self._lock = threading.Lock()
         self._host_rate: Dict[type, float] = {}
+        self._default_host_rate = self.DEFAULT_HOST_ROWS_PER_S
         self._device_fixed_s = self.DEFAULT_DEVICE_FIXED_S
+        self._device_rows_per_s = self.DEFAULT_DEVICE_ROWS_PER_S
+        self._device_measured = False
+        self.reseed_from_knobs()
+
+    def reseed_from_knobs(self) -> None:
+        """Pull cost-model seeds from the tuning registry. With autotune
+        off (or nothing tuned) the registry returns the class defaults —
+        byte-identical behavior. A calibration profile replaces the seeds
+        only; the per-class EWMAs already measured stay authoritative."""
+        from ..tuning import knobs
+
+        with self._lock:
+            self._default_host_rate = knobs.value("router_host_rows_per_s")
+            self._device_rows_per_s = knobs.value("router_device_rows_per_s")
+            if not self._device_measured:
+                # an unmeasured fixed cost re-seeds too; once live launches
+                # have refined it, the EWMA wins over any profile
+                self._device_fixed_s = knobs.value("router_device_fixed_s")
 
     def observe_host(self, cls: type, rows: int, seconds: float) -> None:
         if seconds <= 0 or rows <= 0:
@@ -150,21 +174,22 @@ class CrossoverRouter:
         if seconds <= 0 or folds <= 0:
             return
         per_fold = seconds / folds
-        fixed = max(per_fold - rows / self.DEFAULT_DEVICE_ROWS_PER_S, 1e-4)
         with self._lock:
+            fixed = max(per_fold - rows / self._device_rows_per_s, 1e-4)
             self._device_fixed_s += self._ALPHA * (fixed - self._device_fixed_s)
+            self._device_measured = True
 
     def host_seconds(self, classes, rows: int) -> float:
         with self._lock:
             return sum(
-                rows / self._host_rate.get(cls, self.DEFAULT_HOST_ROWS_PER_S)
+                rows / self._host_rate.get(cls, self._default_host_rate)
                 for cls in classes
             )
 
     def device_seconds(self, rows: int) -> float:
         with self._lock:
             return (
-                self._device_fixed_s + rows / self.DEFAULT_DEVICE_ROWS_PER_S
+                self._device_fixed_s + rows / self._device_rows_per_s
             )
 
     def crossover_rows(self, classes) -> int:
@@ -172,10 +197,10 @@ class CrossoverRouter:
         a battery of these analyzer classes (the PERF.md table's value)."""
         with self._lock:
             per_row_host = sum(
-                1.0 / self._host_rate.get(cls, self.DEFAULT_HOST_ROWS_PER_S)
+                1.0 / self._host_rate.get(cls, self._default_host_rate)
                 for cls in classes
             )
-            margin = per_row_host - 1.0 / self.DEFAULT_DEVICE_ROWS_PER_S
+            margin = per_row_host - 1.0 / self._device_rows_per_s
             if margin <= 0:
                 return 1 << 62  # host never loses
             return int(self._device_fixed_s / margin)
@@ -315,7 +340,7 @@ class _PendingFold:
     __slots__ = (
         "session", "skey", "data", "bucket", "plan", "route", "key",
         "drainable", "monitor", "done", "event", "state", "result", "error",
-        "submitted", "harvested", "handle", "signature",
+        "submitted", "harvested", "handle", "signature", "tuning_arm",
     )
 
     def __init__(self, session, data, bucket, plan, route, key, drainable):
@@ -339,6 +364,7 @@ class _PendingFold:
         self.harvested = False
         self.handle = None      # the scheduler JobHandle, from mark_submitted
         self.signature = ()     # the job's placement signature (device route)
+        self.tuning_arm = None  # knob name when shadow-routed by tuning
 
 
 class FoldCoalescer:
@@ -468,6 +494,7 @@ class FoldCoalescer:
             )
             return None
         route = self.router.route(plan, rows)
+        fleet_forced = False
         if route == "fast" and self._fleet_stream_eligible(
             plan, rows, tenant=session.tenant
         ):
@@ -477,10 +504,22 @@ class FoldCoalescer:
             # the knob would be unreachable for exactly the fast-capable
             # batteries it was documented for
             route = "device"
+            fleet_forced = True
+        tuning_arm = None
+        controller = getattr(self.service, "tuning_controller", None)
+        if controller is not None and plan.fast_ok and not fleet_forced:
+            shadow = controller.choose(rows)
+            if shadow is not None:
+                # this fold measures the CANDIDATE fast-path ceiling: route
+                # it the way the candidate would (the fleet contract above
+                # still outranks any candidate)
+                tuning_arm = "fast_path_max_rows"
+                route = "fast" if shadow == "host" else "device"
         key = (route,) + plan.signatures + (bucket,)
         pending = _PendingFold(
             session, data, bucket, plan, route, key, drainable
         )
+        pending.tuning_arm = tuning_arm
         self.service.metrics.inc(
             "deequ_service_fold_route_total", route=route
         )
@@ -1027,6 +1066,17 @@ class FoldCoalescer:
                         session.checks, AnalyzerContext(metrics)
                     )
                     t_done = time.perf_counter()
+                    controller = getattr(
+                        self.service, "tuning_controller", None
+                    )
+                    if controller is not None:
+                        # route-specific compute only (mirrors
+                        # observe_host's span): the finalize/evaluate tail
+                        # is paid by BOTH routes and would mask the
+                        # routing signal the experiments compare
+                        controller.record(
+                            rows, t_fin - t_part, arm=pending.tuning_arm
+                        )
                     mon.add_phase_time("host_partials", t_fin - t_part)
                     mon.add_phase_time("metric_derivation", t_done - t_fin)
                     mon.bump("passes")
@@ -1392,8 +1442,11 @@ class FoldCoalescer:
             elapsed = time.perf_counter() - t0
         self.router.observe_device(rows, elapsed, width)
         share = elapsed / width
+        controller = getattr(self.service, "tuning_controller", None)
         for f, _, _, _ in prepped:
             f.monitor.add_phase_time("device_dispatch", share)
+            if controller is not None:
+                controller.record(rows, share, arm=f.tuning_arm)
         self._note_width(width, coalesced=True)
         return states_list
 

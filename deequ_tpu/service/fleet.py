@@ -112,12 +112,12 @@ def fleet_enabled() -> bool:
 
 
 def fleet_stream_min_rows() -> int:
-    from ..utils import env_number
+    # registry-resolved (env override > tuned > static 65536): boot
+    # calibration re-derives the sharding floor from this substrate's
+    # measured dispatch cost
+    from ..tuning import knobs
 
-    return env_number(
-        FLEET_STREAM_MIN_ROWS_ENV, DEFAULT_FLEET_STREAM_MIN_ROWS, int,
-        minimum=0,
-    )
+    return knobs.value("fleet_stream_min_rows")
 
 
 def mesh_substrate() -> Dict[str, Any]:

@@ -88,6 +88,7 @@ FIXTURE_EXPECTATIONS = [
      "deequ_service_fixture_undescribed_total"),
     ("state_algebra_bad.py", "state-algebra", "no merge()"),
     ("dead_imports_bad.py", "dead-import", "'json'"),
+    ("tuning_registry_bad.py", "tuning-registry", "FIXTURE_ROUTE_MIN_ROWS"),
 ]
 
 

@@ -1,0 +1,579 @@
+"""Self-tuning performance control plane (ISSUE 18).
+
+Four contracts pinned here:
+
+- **registry resolution**: every routing constant resolves env override >
+  tuned (autotune on) > static default, with tuned values clamped to the
+  registry's audited bounds;
+- **static parity**: ``DEEQU_TPU_AUTOTUNE=0`` makes the tuned layer
+  invisible — every knob read, every migrated reader, and
+  ``probably_low_cardinality`` behave byte-identically to the pre-registry
+  constants even with poisoned tuned values installed;
+- **profile integrity**: calibration profiles round-trip under their
+  content checksum; corrupt/stale/torn files quarantine and surface the
+  typed ``CorruptStateError`` the service boot degrades through;
+- **guardrails**: candidates promote only after beating the incumbent
+  beyond the band on measured traffic, losers roll back, and the
+  never-below-static floor demotes every tuned knob when the live rate
+  falls under the static reference (the planted-mis-calibration drill).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deequ_tpu.exceptions import CorruptStateError
+from deequ_tpu.tuning import knobs
+from deequ_tpu.tuning.controller import TuningController
+from deequ_tpu.tuning.profile import (
+    PROFILE_VERSION,
+    SubstrateProfile,
+    load_profile,
+    profile_dir,
+    save_profile,
+    substrate_fingerprint,
+    substrate_key,
+)
+
+pytestmark = pytest.mark.tuning
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuned_layer(tmp_path, monkeypatch):
+    """Every test starts from static: no tuned values, and any service
+    booted inside the test resolves its profile dir to an empty tmp dir
+    (never the developer's real profile beside the XLA cache)."""
+    knobs.clear_tuned()
+    monkeypatch.setenv(knobs.TUNING_PROFILE_DIR_ENV,
+                       str(tmp_path / "profiles"))
+    yield
+    knobs.clear_tuned()
+
+
+def _profile(knob_values=None, probes=None) -> SubstrateProfile:
+    return SubstrateProfile(
+        substrate=substrate_key(),
+        probes=probes or {"device_fixed_s": 0.002},
+        knob_values=knob_values if knob_values is not None
+        else {"coalesce_max_width": 8},
+        calibration_wall_s=1.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the knob registry: resolution order, bounds, escape hatch
+# ---------------------------------------------------------------------------
+
+def test_every_knob_resolves_to_its_static_default():
+    for name, k in knobs.REGISTRY.items():
+        assert knobs.value(name) == k.static_default, name
+
+
+def test_registry_env_names_follow_the_convention():
+    for k in knobs.REGISTRY.values():
+        if k.env is not None:
+            assert k.env.startswith("DEEQU_TPU_"), k.name
+        assert k.lo <= k.static_default <= k.hi, (
+            f"{k.name}: static default outside its own clamp bounds"
+        )
+
+
+def test_tuned_value_wins_only_with_autotune_on(monkeypatch):
+    knobs.set_tuned("coalesce_max_width", 4, source="test")
+    assert knobs.value("coalesce_max_width") == 4
+    monkeypatch.setenv(knobs.AUTOTUNE_ENV, "0")
+    assert knobs.value("coalesce_max_width") == 16  # static, byte-for-byte
+    monkeypatch.delenv(knobs.AUTOTUNE_ENV)
+    assert knobs.value("coalesce_max_width") == 4
+
+
+def test_env_override_beats_tuned(monkeypatch):
+    knobs.set_tuned("coalesce_max_width", 4, source="test")
+    monkeypatch.setenv("DEEQU_TPU_COALESCE_MAX_WIDTH", "32")
+    assert knobs.value("coalesce_max_width") == 32
+
+
+def test_set_tuned_clamps_to_registry_bounds():
+    assert knobs.set_tuned("coalesce_max_width", 10_000) == 1024
+    assert knobs.set_tuned("coalesce_max_width", 0) == 1
+    assert knobs.set_tuned("prefetch_depth", -3) == 0
+    with pytest.raises(KeyError):
+        knobs.set_tuned("not_a_knob", 1)
+
+
+def test_clear_and_snapshot_round_trip():
+    assert not knobs.any_tuned()
+    knobs.set_tuned("prefetch_depth", 4, source="test")
+    knobs.set_tuned("coalesce_max_width", 8, source="profile")
+    assert knobs.any_tuned()
+    snap = knobs.tuned_snapshot()
+    assert snap["prefetch_depth"] == {
+        "value": 4, "source": "test", "static": 2,
+    }
+    knobs.clear_tuned("prefetch_depth")
+    assert "prefetch_depth" not in knobs.tuned_snapshot()
+    knobs.clear_tuned()
+    assert not knobs.any_tuned()
+
+
+def test_migrated_readers_resolve_through_the_registry(monkeypatch):
+    """The hot-path readers the registry replaced read tuned values with
+    autotune on — and the exact pre-registry defaults with it off."""
+    from deequ_tpu.analyzers import grouping
+    from deequ_tpu.ingest.prefetch import prefetch_depth
+    from deequ_tpu.service.coalesce import (
+        coalesce_max_width,
+        fast_path_max_rows,
+    )
+    from deequ_tpu.service.fleet import fleet_stream_min_rows
+
+    readers = {
+        fast_path_max_rows: ("fast_path_max_rows", -1, 0),
+        coalesce_max_width: ("coalesce_max_width", 16, 4),
+        fleet_stream_min_rows: ("fleet_stream_min_rows", 65536, 4096),
+        prefetch_depth: ("prefetch_depth", 2, 5),
+        grouping.device_freq_max_cardinality: (
+            "device_freq_max_cardinality", 1 << 16, 1 << 10),
+        grouping.freq_table_slots: ("freq_table_slots", 1 << 22, 1 << 12),
+        grouping.freq_buffer_entries: (
+            "freq_buffer_entries", 1 << 25, 1 << 17),
+    }
+    for reader, (name, static, tuned) in readers.items():
+        assert reader() == static, name
+        knobs.set_tuned(name, tuned, source="test")
+        assert reader() == tuned, name
+    monkeypatch.setenv(knobs.AUTOTUNE_ENV, "0")
+    for reader, (name, static, _tuned) in readers.items():
+        assert reader() == static, f"{name}: AUTOTUNE=0 must be static"
+
+
+def test_probably_low_cardinality_static_parity(monkeypatch):
+    """The probe's 2M-row floor and probe sizes are knobs now — but with
+    AUTOTUNE=0 a poisoned tuned layer cannot change a single routing
+    answer (the byte-for-byte escape-hatch pin)."""
+    import numpy as np
+
+    from deequ_tpu.analyzers.grouping import probably_low_cardinality
+    from deequ_tpu.data import Dataset
+
+    rng = np.random.default_rng(7)
+    rows = 1 << 14
+    data = Dataset.from_dict({"k": rng.integers(0, 50, size=rows)})
+
+    baseline = probably_low_cardinality(data, ["k"])
+    assert baseline is False  # under the 2M-row static floor
+
+    # poison the tuned layer: a 0-row floor and doll-sized probe slices
+    # flip the answer...
+    knobs.set_tuned("freq_host_route_min_rows", 0, source="test")
+    knobs.set_tuned("freq_probe_rows", 1024, source="test")
+    assert probably_low_cardinality(data, ["k"]) is True
+    # ...but AUTOTUNE=0 restores the static answer byte-for-byte
+    monkeypatch.setenv(knobs.AUTOTUNE_ENV, "0")
+    assert probably_low_cardinality(data, ["k"]) is baseline
+
+
+def test_router_reseeds_from_tuned_knobs():
+    from deequ_tpu.service.coalesce import CrossoverRouter
+
+    static = CrossoverRouter()
+    assert static.crossover_rows([object]) == int(
+        knobs.static_value("router_device_fixed_s")
+        / (1.0 / knobs.static_value("router_host_rows_per_s")
+           - 1.0 / knobs.static_value("router_device_rows_per_s"))
+    )
+    knobs.set_tuned("router_host_rows_per_s", 1e12, source="test")
+    tuned = CrossoverRouter()
+    # host faster than the device per-row rate: host never loses
+    assert tuned.crossover_rows([object]) == 1 << 62
+    # a measured device launch outranks any later reseed of the fixed cost
+    tuned.observe_device(rows=1 << 20, seconds=0.5, folds=1)
+    fixed = tuned._device_fixed_s
+    knobs.set_tuned("router_device_fixed_s", 5.0, source="test")
+    tuned.reseed_from_knobs()
+    assert tuned._device_fixed_s == fixed
+
+
+# ---------------------------------------------------------------------------
+# profile persistence: checksum round trip, quarantine, staleness
+# ---------------------------------------------------------------------------
+
+def test_profile_round_trip(tmp_path):
+    d = str(tmp_path)
+    saved = _profile({"coalesce_max_width": 8, "prefetch_depth": 3})
+    path = save_profile(saved, d)
+    assert os.path.basename(path) == f"profile-{saved.fingerprint}.json"
+    loaded = load_profile(d)
+    assert loaded is not None
+    assert loaded.knob_values == saved.knob_values
+    assert loaded.probes == saved.probes
+    assert loaded.substrate == substrate_key()
+    assert loaded.created_at > 0
+
+
+def test_missing_profile_is_none_not_an_error(tmp_path):
+    assert load_profile(str(tmp_path)) is None
+
+
+def test_torn_profile_quarantines_and_raises(tmp_path):
+    d = str(tmp_path)
+    path = save_profile(_profile(), d)
+    with open(path, "w") as fh:
+        fh.write("{ torn json")
+    with pytest.raises(CorruptStateError, match="unreadable"):
+        load_profile(d)
+    assert not os.path.exists(path)
+    assert os.listdir(os.path.join(d, ".quarantine"))
+    # the poisoned file can never affect a later boot
+    assert load_profile(d) is None
+
+
+def test_checksum_mismatch_quarantines_and_raises(tmp_path):
+    d = str(tmp_path)
+    path = save_profile(_profile({"coalesce_max_width": 8}), d)
+    with open(path) as fh:
+        record = json.load(fh)
+    record["payload"]["knob_values"]["coalesce_max_width"] = 1024  # tamper
+    with open(path, "w") as fh:
+        json.dump(record, fh)
+    with pytest.raises(CorruptStateError, match="checksum"):
+        load_profile(d)
+    assert not os.path.exists(path)
+
+
+def test_stale_schema_version_quarantines_and_raises(tmp_path):
+    d = str(tmp_path)
+    stale = _profile()
+    stale.version = PROFILE_VERSION + 1
+    path = save_profile(stale, d)
+    with pytest.raises(CorruptStateError, match="version"):
+        load_profile(d)
+    assert not os.path.exists(path)
+
+
+def test_apply_skips_unknown_knobs_and_clamps():
+    profile = _profile({
+        "coalesce_max_width": 10_000,     # above the hi bound
+        "knob_from_the_future": 42,       # newer build's knob
+    })
+    applied = profile.apply(source="test")
+    assert applied == {"coalesce_max_width": 1024}
+    assert knobs.value("coalesce_max_width") == 1024
+    assert "knob_from_the_future" not in knobs.tuned_snapshot()
+
+
+def test_profile_dir_honors_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(knobs.TUNING_PROFILE_DIR_ENV, str(tmp_path / "p"))
+    assert profile_dir() == str(tmp_path / "p")
+
+
+# ---------------------------------------------------------------------------
+# boot-time calibration (small probes: the real probe/derive/save loop)
+# ---------------------------------------------------------------------------
+
+def test_calibrate_smoke_derives_in_bounds_and_persists(tmp_path):
+    from deequ_tpu.tuning.calibrate import calibrate
+
+    d = str(tmp_path)
+    profile = calibrate(save=True, profile_dir=d, rows=1 << 12, repeats=1)
+    assert profile.calibration_wall_s > 0
+    assert profile.probes["device_fixed_s"] > 0
+    assert profile.probes["device_rows_per_s"] > 0
+    assert profile.probes["group_host_rows_per_s"] > 0
+    for name, value in profile.knob_values.items():
+        k = knobs.REGISTRY[name]
+        assert k.lo <= value <= k.hi, name
+        assert k.cast(value) == value, name
+    # calibrate() measures; it never installs into the live registry
+    assert not knobs.any_tuned()
+    loaded = load_profile(d)
+    assert loaded is not None
+    assert loaded.knob_values == profile.knob_values
+
+
+def test_derive_knobs_cost_model():
+    from deequ_tpu.tuning.calibrate import derive_knobs
+
+    derived = derive_knobs({
+        "host_rows_per_s_Mean": 40e6,
+        "device_fixed_s": 0.004,
+        "device_rows_per_s": 64e6,
+        "device_stack_slope_s": 0.0005,
+        "staging_rows_per_s": 16e6,
+        "group_host_rows_per_s": 64e6,
+        "group_device_rows_per_s": 16e6,
+    })
+    assert derived["router_host_rows_per_s"] == 40e6
+    # 0.25 * 4ms * 64M = 64k rows -> largest power of two at most that
+    assert derived["fleet_stream_min_rows"] == 32768
+    # fixed/slope = 8 launches' worth of stacking
+    assert derived["coalesce_max_width"] == 8
+    # device consumes 4x faster than staging feeds: deeper pipeline
+    assert derived["prefetch_depth"] == 5
+    # host group-by 4x faster: distinct ceiling scales up (clamped ratio)
+    assert derived["freq_host_route_max_distinct"] == (1 << 15) * 4
+
+
+# ---------------------------------------------------------------------------
+# the online controller: promotion bands, rollback, the static floor
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def metrics():
+    from deequ_tpu.service.metrics import ServiceMetrics
+
+    return ServiceMetrics()
+
+
+@pytest.fixture()
+def fast_decisions(monkeypatch):
+    monkeypatch.setenv(knobs.TUNING_MIN_SAMPLES_ENV, "4")
+    monkeypatch.setenv(knobs.TUNING_SHADOW_FRACTION_ENV, "0.25")
+
+
+def test_shadow_candidate_promotes_after_winning(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    assert ctl.propose("fast_path_max_rows", 8192, mode="shadow")
+    assert not ctl.propose("fast_path_max_rows", 4096)  # one per knob
+    for _ in range(8):
+        ctl.record(4096, seconds=0.010)                   # incumbent: 410k/s
+        ctl.record(4096, seconds=0.001, arm="fast_path_max_rows")  # 4.1M/s
+    snap = ctl.snapshot()
+    assert snap["experiments"] == {}
+    assert snap["tuned"]["fast_path_max_rows"]["value"] == 8192
+    assert snap["decisions"][-1]["verdict"] == "promote"
+    assert metrics.counter_value(
+        "deequ_service_tuning_promotions_total") == 1.0
+    assert metrics.counter_value(
+        "deequ_service_tuning_proposals_total") == 1.0
+
+
+def test_shadow_candidate_rejects_inside_the_band(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    ctl.propose("fast_path_max_rows", 8192, mode="shadow")
+    for _ in range(8):
+        ctl.record(4096, seconds=0.010)
+        ctl.record(4096, seconds=0.009, arm="fast_path_max_rows")
+    snap = ctl.snapshot()
+    assert "fast_path_max_rows" not in snap["tuned"]  # ~1.1x < 1.25x band
+    assert snap["decisions"][-1]["verdict"] == "reject"
+    assert metrics.counter_value(
+        "deequ_service_tuning_demotions_total") == 1.0
+
+
+def test_starved_shadow_arm_eventually_rejects(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    ctl.propose("fast_path_max_rows", 8192, mode="shadow")
+    for _ in range(4 * 20):
+        ctl.record(4096, seconds=0.005)  # incumbent only: no shadow folds
+    snap = ctl.snapshot()
+    assert snap["experiments"] == {}
+    assert snap["decisions"][-1]["verdict"] == "reject"
+    assert "fast_path_max_rows" not in snap["tuned"]
+
+
+def test_trial_candidate_installs_then_rolls_back(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    for _ in range(6):
+        ctl.record(4096, seconds=0.002)  # baseline rate before the flip
+    ctl.propose("coalesce_max_width", 8, mode="trial")
+    assert knobs.value("coalesce_max_width") == 8  # tentatively live
+    for _ in range(4):
+        ctl.record(4096, seconds=0.004)  # regressed under the candidate
+    assert knobs.value("coalesce_max_width") == 16  # rolled back to static
+    assert "coalesce_max_width" not in knobs.tuned_snapshot()
+    assert ctl.snapshot()["decisions"][-1]["verdict"] == "reject"
+
+
+def test_trial_candidate_promotes_beyond_band(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    for _ in range(6):
+        ctl.record(4096, seconds=0.010)
+    ctl.propose("coalesce_max_width", 8, mode="trial")
+    for _ in range(10):
+        ctl.record(4096, seconds=0.001)  # 10x the baseline
+    assert knobs.tuned_snapshot()["coalesce_max_width"]["value"] == 8
+    assert ctl.snapshot()["decisions"][-1]["verdict"] == "promote"
+
+
+def test_floor_guardrail_demotes_planted_miscalibration(
+        metrics, fast_decisions):
+    """The acceptance drill's core: plant a mis-calibration, feed folds
+    that measure WORSE than the static floor, and the guardrail must
+    demote every tuned knob — never leaving the system below static."""
+    ctl = TuningController(metrics=metrics)
+    for _ in range(8):
+        ctl.record(4096, seconds=0.002)  # static floor ~2M rows/s
+    knobs.set_tuned("coalesce_max_width", 1, source="bad-profile")
+    knobs.set_tuned("prefetch_depth", 0, source="bad-profile")
+    for _ in range(8):
+        ctl.record(4096, seconds=0.020)  # 10x slower than the floor
+    assert not knobs.any_tuned(), "floor guardrail must demote ALL knobs"
+    decision = ctl.snapshot()["decisions"][-1]
+    assert decision["verdict"] == "floor_demotion"
+    assert "coalesce_max_width" in decision["knob"]
+    assert "prefetch_depth" in decision["knob"]
+    assert metrics.counter_value(
+        "deequ_service_tuning_demotions_total") == 2.0
+    # the live EWMA restarted at the demotion (mid-loop, as soon as the
+    # sample requirement filled): only post-demotion folds remain in it
+    assert ctl.snapshot()["live_samples"] < 8
+
+
+def test_floor_never_fires_at_static(metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    for _ in range(50):
+        ctl.record(4096, seconds=0.002)
+    assert ctl.snapshot()["decisions"] == []
+
+
+def test_choose_is_deterministic_and_counts_shadow_folds(
+        metrics, fast_decisions):
+    ctl = TuningController(metrics=metrics)
+    ctl.propose("fast_path_max_rows", 8192, mode="shadow")
+    arms = [ctl.choose(4096) for _ in range(12)]
+    # fraction 0.25 -> period 4: folds 4, 8, 12 ride the candidate arm
+    assert arms == [None, None, None, "host"] * 3
+    # the next shadow fold (fold 16) carries rows above the candidate
+    # ceiling: the forced arm is the device route
+    assert [ctl.choose(1 << 20) for _ in range(4)][-1] == "device"
+    assert metrics.counter_value(
+        "deequ_service_tuning_shadow_folds_total") == 4.0
+    assert ctl.choose(4096) is None and ctl.choose(4096) is None
+
+
+def test_refit_reproposes_only_missing_profile_knobs(fast_decisions):
+    profile = _profile({
+        "coalesce_max_width": 8,
+        "prefetch_depth": 4,
+        "router_device_fixed_s": 0.001,
+    })
+    knobs.set_tuned("coalesce_max_width", 8, source="profile")
+    ctl = TuningController(profile=profile)
+    assert ctl.refit() == 1  # prefetch_depth only: width held, router skipped
+    assert set(ctl.snapshot()["experiments"]) == {"prefetch_depth"}
+    assert ctl.refit() == 0  # already experimenting
+
+
+def test_decision_history_is_bounded(metrics, fast_decisions):
+    from deequ_tpu.tuning.controller import _MAX_DECISIONS
+
+    ctl = TuningController(metrics=metrics)
+    for i in range(_MAX_DECISIONS + 40):
+        ctl.propose("coalesce_max_width", 8 if i % 2 else 4, mode="trial")
+        for _ in range(4):
+            ctl.record(4096, seconds=0.002)
+        knobs.clear_tuned()
+    assert len(ctl.snapshot()["decisions"]) <= _MAX_DECISIONS
+
+
+# ---------------------------------------------------------------------------
+# service bootstrap: the wired-in plane and its escape hatch
+# ---------------------------------------------------------------------------
+
+def _boot_service():
+    from deequ_tpu.service import VerificationService
+
+    return VerificationService(background_warm=False)
+
+
+def test_autotune_off_boots_no_controller(monkeypatch):
+    monkeypatch.setenv(knobs.AUTOTUNE_ENV, "0")
+    with _boot_service() as service:
+        assert service.tuning_controller is None
+        # a disabled plane still exports described zeros (no dashboard gaps)
+        assert service.metrics.counter_value(
+            "deequ_service_tuning_promotions_total") == 0.0
+
+
+def test_boot_applies_profile_and_starts_controller(tmp_path, monkeypatch):
+    d = str(tmp_path / "profiles")
+    monkeypatch.setenv(knobs.TUNING_PROFILE_DIR_ENV, d)
+    save_profile(_profile({"coalesce_max_width": 8,
+                           "router_host_rows_per_s": 1e12}), d)
+    with _boot_service() as service:
+        ctl = service.tuning_controller
+        assert ctl is not None and ctl.profile is not None
+        assert knobs.tuned_snapshot()["coalesce_max_width"]["source"] == (
+            "profile")
+        # the router reseeded from the tuned seeds at boot
+        assert service.coalescer.router._default_host_rate == 1e12
+
+
+def test_corrupt_profile_boots_static_with_quarantine(tmp_path, monkeypatch):
+    d = str(tmp_path / "profiles")
+    monkeypatch.setenv(knobs.TUNING_PROFILE_DIR_ENV, d)
+    path = save_profile(_profile({"coalesce_max_width": 8}), d)
+    with open(path, "w") as fh:
+        fh.write("not json")
+    with _boot_service() as service:
+        assert service.tuning_controller is not None
+        assert service.tuning_controller.profile is None
+        assert not knobs.any_tuned()  # static fallback, no poisoned knobs
+    assert os.listdir(os.path.join(d, ".quarantine"))
+
+
+# ---------------------------------------------------------------------------
+# two-substrate parity drill: the same home directory serves distinct
+# profiles to distinct substrates (8-virtual-device CPU mesh vs this host)
+# ---------------------------------------------------------------------------
+
+_MESH_DRILL = r"""
+import json, sys
+from deequ_tpu.tuning.calibrate import calibrate
+from deequ_tpu.tuning.profile import load_profile, substrate_key
+
+profile = calibrate(save=True, profile_dir=sys.argv[1],
+                    rows=1 << 12, repeats=1)
+loaded = load_profile(sys.argv[1])
+print(json.dumps({
+    "fingerprint": profile.fingerprint,
+    "chip_count": substrate_key()["chip_count"],
+    "round_trip": loaded is not None
+                  and loaded.knob_values == profile.knob_values,
+}))
+"""
+
+
+def _calibrate_drill(directory: str, device_count: int) -> dict:
+    """Run the calibrate drill in a child forced to ``device_count``
+    virtual CPU devices (replacing any inherited force flag — the pytest
+    process itself runs under an 8-device mesh)."""
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={device_count}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.pop("DEEQU_TPU_TUNING_PROFILE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _MESH_DRILL, directory],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_two_substrate_profiles_coexist(tmp_path):
+    """Calibrate a single-device substrate and an 8-virtual-device CPU
+    mesh into the same directory: different fingerprints, two files, and
+    each loader resolves only its own substrate's profile."""
+    d = str(tmp_path / "shared")
+    solo = _calibrate_drill(d, 1)
+    mesh = _calibrate_drill(d, 8)
+    assert solo["round_trip"] is True and mesh["round_trip"] is True
+    assert solo["chip_count"] == 1
+    assert mesh["chip_count"] == 8
+    assert solo["fingerprint"] != mesh["fingerprint"]
+    files = [f for f in os.listdir(d) if f.startswith("profile-")]
+    assert len(files) == 2, files
+    # the pytest process is itself the 8-device substrate: from the
+    # shared dir it resolves ONLY the mesh profile
+    loaded = load_profile(d)
+    assert loaded is not None and loaded.fingerprint == mesh["fingerprint"]

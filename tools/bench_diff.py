@@ -88,6 +88,11 @@ _SCALARS: List[Tuple[str, str, str]] = [
     # the stage, drift gated here) must not rot
     ("catalog_soak", "catalog_soak_sessions_per_s", "throughput"),
     ("catalog_soak", "gated_throughput_fraction", "throughput"),
+    # self-tuning plane (ISSUE 18): the TUNED point's throughput must not
+    # rot across rounds; the in-run tuned-vs-static gate lives in
+    # diff_metrics (needs no committed baseline)
+    ("calibration", "tuning_streaming_sessions_per_s_tuned", "throughput"),
+    ("calibration", "tuning_grouping_rows_per_s_tuned", "throughput"),
 ]
 
 
@@ -210,6 +215,30 @@ def diff_metrics(
             "mesh_scaling", f"mesh_scaling_rows_per_sec[{n_dev}]",
             new, old, "throughput",
         )
+
+    # self-tuning IN-RUN gate (ISSUE 18): the calibration stage measures
+    # the SAME workload point static vs tuned in the SAME run, so this
+    # comparison needs no committed baseline — tuned must be >= static
+    # within the band. A tuner that makes the box slower is a regression
+    # even if both numbers beat the committed trajectory.
+    for metric_base in ("tuning_streaming_sessions_per_s",
+                        "tuning_grouping_rows_per_s"):
+        static_v = fresh.get(f"{metric_base}_static")
+        tuned_v = fresh.get(f"{metric_base}_tuned")
+        if static_v in (None, 0) or tuned_v is None:
+            continue
+        ratio = tuned_v / static_v
+        entry = {
+            "stage": "calibration",
+            "metric": f"{metric_base}_tuned_vs_static",
+            "committed": round(float(static_v), 2),
+            "fresh": round(float(tuned_v), 2),
+            "ratio": round(ratio, 3), "kind": "throughput",
+        }
+        if ratio < 1.0 - tolerance:
+            regressions.append(entry)
+        elif ratio > 1.0 + tolerance:
+            improvements.append(entry)
 
     # compile counts: a warm stage that recompiles regressed regardless
     # of wall clock (the compile-budget contract, per-stage)

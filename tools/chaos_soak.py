@@ -184,6 +184,10 @@ def run_soak(
             summary["fleet_drill"] = _fleet_drill()
             summary["catalog_drill"] = _catalog_drill()
             summary["row_gate_drill"] = _row_gate_drill(service)
+            summary["tuning_drill"] = _tuning_drill(service)
+            from tools.tuning_report import controller_report
+
+            summary["tuning_report"] = controller_report(service)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -213,6 +217,7 @@ def run_soak(
         "fleet_drill": summary["fleet_drill"]["ok"],
         "catalog_drill": summary["catalog_drill"]["ok"],
         "row_gate_drill": summary["row_gate_drill"]["ok"],
+        "tuning_drill": summary["tuning_drill"]["ok"],
     }
     if "cluster_drill" in summary:
         invariants["cluster_drill"] = summary["cluster_drill"]["ok"]
@@ -705,6 +710,97 @@ def _coalesce_drill(service) -> Dict:
     out["ok"] = (
         outcomes == ["ok", "ok", "quarantined", "ok"]
         and out["committed"] == [1, 1, 0, 1]
+    )
+    return out
+
+
+def _tuning_drill(service) -> Dict:
+    """Self-tuning guardrail drill, run inside the soak against the live
+    service: a PLANTED mis-calibration (``fast_path_max_rows=0`` tuned in
+    — "the crossover says the device always wins" — forcing every small
+    fold onto the fixed-cost device path) must be demoted back to static
+    defaults by the controller's never-below-static floor, and the
+    post-demotion ingest rate must not sit below the static-default
+    reference burst. The drill measures three bursts — static reference,
+    poisoned, recovered — through one streaming session; the controller
+    sees every fold via the coalescer's timing sites. The battery is
+    fast-path-capable and the folds sit below the fleet-sharding
+    threshold, so routing (not sharding) is the only variable.
+    ``inject()`` swaps the soak's ambient fault plan out — an injected
+    fold crash would fail the bursts and corrupt the timing evidence."""
+    import os
+    import time as _time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.reliability import inject
+    from deequ_tpu.tuning import knobs
+
+    controller = getattr(service, "tuning_controller", None)
+    out: Dict = {}
+    if controller is None:
+        # DEEQU_TPU_AUTOTUNE=0 soaks have no controller to drill; that is
+        # the escape hatch working, not a failure
+        out["skipped"] = "autotune disabled"
+        out["ok"] = True
+        return out
+
+    checks = [
+        Check(CheckLevel.ERROR, "tuning drill")
+        .is_complete("x")
+        .has_mean("y", lambda m: 5.0 < m < 15.0)
+    ]
+    session = service.session("tuning-drill", "stream", checks)
+    rng = np.random.default_rng(77)
+    table = pa.table({
+        "x": rng.normal(size=8192),
+        "y": rng.normal(10.0, 2.0, size=8192),
+    })
+
+    def burst(n: int) -> float:
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            session.ingest(table, timeout=120)
+        return n / (_time.perf_counter() - t0)
+
+    # verdicts must land within the drill's bursts, not after hours of
+    # soak traffic; restore the operator's env afterwards
+    saved = os.environ.get("DEEQU_TPU_TUNING_MIN_SAMPLES")
+    os.environ["DEEQU_TPU_TUNING_MIN_SAMPLES"] = "8"
+    try:
+        with inject():
+            knobs.clear_tuned()  # the floor must be measured at true static
+            session.ingest(table, timeout=120)  # warm the static route
+            out["static_sessions_per_s"] = burst(24)
+            demotions_before = service.metrics.counter_value(
+                "deequ_service_tuning_demotions_total"
+            )
+            knobs.set_tuned("fast_path_max_rows", 0, source="drill")
+            out["poisoned_sessions_per_s"] = burst(24)
+            out["recovered_sessions_per_s"] = burst(24)
+            out["demoted"] = not knobs.tuned_snapshot()
+            out["floor_demotions"] = service.metrics.counter_value(
+                "deequ_service_tuning_demotions_total"
+            ) - demotions_before
+            out["decisions"] = [
+                d["verdict"] for d in controller.snapshot()["decisions"]
+            ]
+    finally:
+        knobs.clear_tuned()
+        if saved is None:
+            os.environ.pop("DEEQU_TPU_TUNING_MIN_SAMPLES", None)
+        else:
+            os.environ["DEEQU_TPU_TUNING_MIN_SAMPLES"] = saved
+    out["ok"] = (
+        out.get("demoted", False)
+        and out.get("floor_demotions", 0) >= 1
+        # generous band: the recovered burst runs the same static config
+        # as the reference, so halving it would mean the guardrail failed
+        # to actually restore the static path
+        and out.get("recovered_sessions_per_s", 0.0)
+        >= 0.5 * out.get("static_sessions_per_s", float("inf"))
     )
     return out
 

@@ -10,6 +10,7 @@ from . import (  # noqa: F401
     lock_discipline,
     state_algebra,
     trace_purity,
+    tuning_registry,
 )
 
 ALL_CHECKS = (
@@ -20,4 +21,5 @@ ALL_CHECKS = (
     export_help,
     state_algebra,
     dead_imports,
+    tuning_registry,
 )

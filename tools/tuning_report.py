@@ -1,0 +1,244 @@
+"""Render the self-tuning plane's state: the active substrate profile
+plus the live controller's experiments and decision history.
+
+Two consumers:
+
+- operators: ``python -m tools.tuning_report`` loads THIS substrate's
+  persisted calibration profile (the same loader the service boots
+  through, including checksum verification) and prints probes + derived
+  knob values against their static defaults;
+- chaos_soak: ``controller_report(service)`` renders the in-process
+  controller — incumbent vs candidate rates per experiment, the
+  promotion/demotion history, the ``deequ_service_tuning_*`` counters —
+  into the soak summary, so every soak run documents what the tuner did
+  to it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def profile_report(directory: Optional[str] = None) -> Dict[str, Any]:
+    """This substrate's profile as a report dict (CorruptStateError
+    surfaces as a quarantine note, exactly like the service boot)."""
+    from deequ_tpu.exceptions import CorruptStateError
+    from deequ_tpu.tuning import knobs, profile as prof
+
+    out: Dict[str, Any] = {
+        "substrate": prof.substrate_key(),
+        "fingerprint": prof.substrate_fingerprint(),
+        "profile_dir": directory or prof.profile_dir(),
+    }
+    try:
+        loaded = prof.load_profile(directory)
+    except CorruptStateError as exc:
+        out["profile"] = None
+        out["quarantined"] = str(exc)
+        return out
+    if loaded is None:
+        out["profile"] = None
+        return out
+    out["profile"] = {
+        "created_at": loaded.created_at,
+        "calibration_wall_s": loaded.calibration_wall_s,
+        "probes": loaded.probes,
+        "knobs": {
+            name: {"calibrated": value, "static": knobs.static_value(name)}
+            for name, value in sorted(loaded.knob_values.items())
+            if name in knobs.REGISTRY
+        },
+    }
+    return out
+
+
+def controller_report(service) -> Dict[str, Any]:
+    """The live controller's decisions + the tuning export series of one
+    in-process VerificationService (chaos_soak's summary hook)."""
+    controller = getattr(service, "tuning_controller", None)
+    metrics = getattr(service, "metrics", None)
+    out: Dict[str, Any] = {"enabled": controller is not None}
+    if metrics is not None:
+        out["series"] = {
+            name: metrics.counter_value(name)
+            for name in (
+                "deequ_service_tuning_proposals_total",
+                "deequ_service_tuning_promotions_total",
+                "deequ_service_tuning_demotions_total",
+                "deequ_service_tuning_shadow_folds_total",
+            )
+        }
+    if controller is not None:
+        out.update(controller.snapshot())
+    return out
+
+
+def bench_point(sessions: int = 96, rows: int = 4096,
+                group_rows: int = 1 << 19,
+                group_cardinality: int = 1 << 10) -> Dict[str, Any]:
+    """One tuned-vs-static comparison point under the CURRENT env:
+    streaming sessions/s (the knee workload's shape: N sessions x one
+    micro-batch) and grouping rows/s (a warm Uniqueness run). bench.py's
+    calibration stage runs this twice in detached subprocesses —
+    DEEQU_TPU_AUTOTUNE=0 vs the calibrated profile — and bench_diff
+    gates tuned >= static within the band."""
+    import os
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers import Uniqueness
+    from deequ_tpu.checks import Check, CheckLevel
+    from deequ_tpu.data import Dataset
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+    from deequ_tpu.service import VerificationService
+    from deequ_tpu.tuning import knobs
+
+    rng = np.random.default_rng(0xBE9C4)
+    checks = [
+        Check(CheckLevel.ERROR, "tuning point")
+        .is_complete("x")
+        .has_mean("y", lambda m: -5.0 < m < 5.0)
+    ]
+    table = pa.table({
+        "x": rng.normal(size=rows),
+        "y": rng.normal(size=rows),
+    })
+    with VerificationService(background_warm=False) as service:
+        warm = service.session("tuning-point-warm", "stream", checks)
+        # Warm BOTH fold routes before timing (forced via the override
+        # knob, saved/restored): the arms may settle on different routes
+        # — the calibrated router flips to device as soon as the host
+        # EWMA absorbs its first-fold setup cost, the static 20ms fixed
+        # seed never does — and whichever route the timed loop takes
+        # must not pay its one-time program compile inside the window.
+        route_env = "DEEQU_TPU_FAST_PATH_MAX_ROWS"
+        saved = os.environ.get(route_env)
+        try:
+            os.environ[route_env] = "0"  # force the device route
+            warm.ingest(table, timeout=120)
+            os.environ[route_env] = str(1 << 30)  # force the host route
+            warm.ingest(table, timeout=120)
+        finally:
+            if saved is None:
+                os.environ.pop(route_env, None)
+            else:
+                os.environ[route_env] = saved
+        warm.ingest(table, timeout=120)  # settle the model's own route
+        t0 = time.perf_counter()
+        for i in range(sessions):
+            s = service.session(f"tuning-point-{i}", "stream", checks)
+            s.ingest(table, timeout=120)
+        streaming = sessions / (time.perf_counter() - t0)
+
+    gdata = Dataset.from_dict({
+        "k": rng.integers(0, group_cardinality, size=group_rows),
+    })
+    analyzers = [Uniqueness(["k"])]
+    AnalysisRunner.do_analysis_run(gdata, analyzers)  # warm
+    t0 = time.perf_counter()
+    AnalysisRunner.do_analysis_run(gdata, analyzers)
+    grouping = group_rows / (time.perf_counter() - t0)
+
+    return {
+        "sessions": sessions,
+        "rows": rows,
+        "group_rows": group_rows,
+        "sessions_per_s": streaming,
+        "grouping_rows_per_s": grouping,
+        "autotune": knobs.autotune_enabled(),
+        "tuned_knobs": sorted(knobs.tuned_snapshot()),
+    }
+
+
+def _fmt(value: Any) -> str:
+    if isinstance(value, float):
+        return f"{value:.4g}"
+    return str(value)
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    sub = report["substrate"]
+    lines.append(
+        f"substrate {report['fingerprint']}: {sub['backend']} "
+        f"{sub['device_kind']} x{sub['chip_count']} on {sub['host']}"
+    )
+    lines.append(f"profile dir: {report['profile_dir']}")
+    if report.get("quarantined"):
+        lines.append(f"PROFILE QUARANTINED: {report['quarantined']}")
+        return "\n".join(lines)
+    profile = report.get("profile")
+    if profile is None:
+        lines.append("no profile for this substrate "
+                     "(run python -m deequ_tpu.tuning.calibrate)")
+        return "\n".join(lines)
+    lines.append(
+        f"calibrated in {profile['calibration_wall_s']:.2f}s; "
+        f"{len(profile['probes'])} probes"
+    )
+    lines.append(f"{'knob':34s} {'calibrated':>14s} {'static':>14s}")
+    for name, row in profile["knobs"].items():
+        lines.append(
+            f"{name:34s} {_fmt(row['calibrated']):>14s} "
+            f"{_fmt(row['static']):>14s}"
+        )
+    lines.append("probes:")
+    for name, value in sorted(profile["probes"].items()):
+        lines.append(f"  {name:34s} {_fmt(value):>14s}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tuning_report",
+        description=(
+            "Show this substrate's calibration profile (and, via "
+            "--snapshot, a serialized controller state)"
+        ),
+    )
+    parser.add_argument("--dir", default=None,
+                        help="profile directory (default: beside XLA cache)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--snapshot", default=None,
+                        help="also render a controller snapshot JSON file "
+                             "(as written by chaos_soak)")
+    parser.add_argument("--bench-point", action="store_true",
+                        help="measure one streaming+grouping throughput "
+                             "point under the current env and print it as "
+                             "a JSON line (bench.py's tuned-vs-static probe)")
+    parser.add_argument("--sessions", type=int, default=96,
+                        help="streaming sessions for --bench-point")
+    parser.add_argument("--group-rows", type=int, default=1 << 19,
+                        help="grouping rows for --bench-point")
+    args = parser.parse_args(argv)
+
+    if args.bench_point:
+        point = bench_point(sessions=args.sessions,
+                            group_rows=args.group_rows)
+        print(json.dumps(point, sort_keys=True))
+        return 0
+
+    report = profile_report(args.dir)
+    if args.snapshot:
+        with open(args.snapshot, "r", encoding="utf-8") as fh:
+            report["controller"] = json.load(fh)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        print(render_text(report))
+        controller = report.get("controller")
+        if controller:
+            print(f"controller: {len(controller.get('decisions', []))} "
+                  f"decision(s), {len(controller.get('tuned', {}))} tuned "
+                  "knob(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
