@@ -1084,6 +1084,14 @@ def run_ingest_stage(rows: int) -> dict:
         f"{soak['sessions_per_s']:.0f} sessions/s, {soak['mb_per_s']:.0f} "
         f"MB/s, shed={soak['shed']}, failed={soak['failed_folds']}"
     )
+    if "fold_latency_p99_s" in soak:
+        log(
+            f"[ingest] soak tail latency: fold "
+            f"p50={soak.get('fold_latency_p50_s', 0) * 1e3:.1f}ms "
+            f"p99={soak['fold_latency_p99_s'] * 1e3:.1f}ms, admission "
+            f"wait p99={soak.get('admission_wait_p99_s', 0) * 1e3:.1f}ms "
+            "(from the per-tenant SLO histograms)"
+        )
     if not soak["ok"]:
         log("[ingest] soak FAILED (incomplete sessions or failed folds)")
         sys.exit(1)
@@ -1098,6 +1106,16 @@ def run_ingest_stage(rows: int) -> dict:
         "soak_sessions_per_s": soak["sessions_per_s"],
         "soak_mb_per_s": soak["mb_per_s"],
         "soak_shed": soak["shed"],
+        # absent on runs whose histograms never filled (bench_diff
+        # tolerates missing scalars in OLDER runs by design)
+        **{
+            k: soak[k]
+            for k in (
+                "fold_latency_p50_s", "fold_latency_p99_s",
+                "admission_wait_p50_s", "admission_wait_p99_s",
+            )
+            if k in soak
+        },
     }
 
 
@@ -2016,6 +2034,12 @@ def main() -> None:
         out["ingest_soak_sessions"] = ingest["soak_sessions"]
         out["ingest_soak_sessions_per_s"] = ingest["soak_sessions_per_s"]
         out["ingest_soak_mb_per_s"] = ingest["soak_mb_per_s"]
+        for q_key in (
+            "fold_latency_p50_s", "fold_latency_p99_s",
+            "admission_wait_p50_s", "admission_wait_p99_s",
+        ):
+            if q_key in ingest:
+                out[f"ingest_{q_key}"] = ingest[q_key]
         checkpoint("ingest", extra=ingest)
 
     device = staged("device_scan", run_device_resident_stage)

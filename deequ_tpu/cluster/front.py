@@ -173,10 +173,16 @@ class FrontTier:
         spec so migration/recovery can re-create it elsewhere. Returns
         the placed host id."""
         key = _key(tenant, dataset)
-        with self._lock:
+        with self._lock, _trace.span(
+            "cluster_open", kind="cluster", session=_ring_key(key)
+        ) as sp:
             host = self.route(tenant, dataset)
+            if sp is not _trace.NULL:
+                sp.set_attr("target", host)
             self._specs[key] = (tuple(checks), dict(kw))
-            self.workers[host].open_session(tenant, dataset, checks, **kw)
+            self.workers[host].open_session(
+                tenant, dataset, checks, trace_ctx=_trace.inject(), **kw
+            )
             self._placements[key] = host
             self._journal.setdefault(key, [])
             return host
@@ -189,20 +195,27 @@ class FrontTier:
         the session AFTER this fold commits — bounding replay memory for
         producers that never reach a natural flush boundary."""
         key = _key(tenant, dataset)
-        with self._lock:
-            if key not in self._placements:
-                raise KeyError(
-                    f"unknown session {tenant}/{dataset}: open it via the "
-                    "front tier first"
-                )
-            owner = self.route(tenant, dataset)
-            if owner != self._placements[key]:
-                self._migrate_locked(key, owner)
-            worker = self.workers[self._placements[key]]
-            journal = self._journal.setdefault(key, [])
-            journal.append(data)
-            force_flush = len(journal) >= self._journal_max_folds
-        result = worker.ingest(tenant, dataset, data, **kw)
+        with _trace.span(
+            "cluster_ingest", kind="cluster", session=_ring_key(key)
+        ) as sp:
+            with self._lock:
+                if key not in self._placements:
+                    raise KeyError(
+                        f"unknown session {tenant}/{dataset}: open it via "
+                        "the front tier first"
+                    )
+                owner = self.route(tenant, dataset)
+                if owner != self._placements[key]:
+                    self._migrate_locked(key, owner)
+                worker = self.workers[self._placements[key]]
+                if sp is not _trace.NULL:
+                    sp.set_attr("target", self._placements[key])
+                journal = self._journal.setdefault(key, [])
+                journal.append(data)
+                force_flush = len(journal) >= self._journal_max_folds
+            result = worker.ingest(
+                tenant, dataset, data, trace_ctx=_trace.inject(sp), **kw
+            )
         if force_flush:
             # flush only AFTER the worker committed this fold: flushing
             # first would clear a journal entry whose fold has not
@@ -219,11 +232,15 @@ class FrontTier:
         contract) to the partition store and clear its replay journal —
         everything journaled is now durably committed."""
         key = _key(tenant, dataset)
-        with self._lock:
+        with self._lock, _trace.span(
+            "cluster_flush", kind="cluster", session=_ring_key(key)
+        ):
             host = self._placements.get(key)
             if host is None:
                 return None
-            name = self.workers[host].flush(tenant, dataset)
+            name = self.workers[host].flush(
+                tenant, dataset, trace_ctx=_trace.inject()
+            )
             if name is not None:
                 self._journal[key] = []
             return name
@@ -250,10 +267,13 @@ class FrontTier:
         ):
             partition = None
             if old_host is not None and old_host in self.workers:
-                partition = self.workers[old_host].release(tenant, dataset)
+                partition = self.workers[old_host].release(
+                    tenant, dataset, trace_ctx=_trace.inject()
+                )
             self.workers[new_host].adopt_session(
                 tenant, dataset, checks,
-                partition=partition or session_partition(tenant), **dict(kw),
+                partition=partition or session_partition(tenant),
+                trace_ctx=_trace.inject(), **dict(kw),
             )
             self._placements[key] = new_host
             if partition is not None:
@@ -296,14 +316,16 @@ class FrontTier:
                     # shared store — the dead host cannot flush again, so
                     # no fold can double-commit...
                     self.workers[new_host].adopt_session(
-                        tenant, dataset, checks, **dict(kw)
+                        tenant, dataset, checks,
+                        trace_ctx=_trace.inject(), **dict(kw)
                     )
                     # ...and replay the journal — every payload accepted
                     # since that flush — so no fold is lost either
                     replayed = 0
                     for payload in self._journal.get(key, []):
                         self.workers[new_host].ingest(
-                            tenant, dataset, payload
+                            tenant, dataset, payload,
+                            trace_ctx=_trace.inject(),
                         )
                         replayed += 1
                     self._placements[key] = new_host

@@ -24,8 +24,10 @@ tier can tell a live host from a dead one.
 from __future__ import annotations
 
 import logging
+from contextlib import contextmanager
 from typing import Any, Optional, Sequence
 
+from ..observability import trace as _trace
 from .membership import HeartbeatMembership
 
 _logger = logging.getLogger(__name__)
@@ -57,6 +59,32 @@ class LocalWorker:
         self.membership = membership
         if membership is not None and not membership.host_id:
             membership.host_id = self.host_id
+        # a cluster-attached worker owns the truth about ITS half of the
+        # cluster plane: overwrite the service's default detached
+        # /statusz section with the per-host view (membership + owned
+        # sessions) — honest per-host reporting; ring ownership lives on
+        # the front tier
+        statusz = getattr(service, "statusz", None)
+        if statusz is not None:
+            statusz.register("cluster", self._statusz_section)
+
+    def _statusz_section(self) -> dict:
+        section: dict = {"attached": True, "host": self.host_id}
+        if self.membership is not None:
+            try:
+                section["members"] = sorted(self.membership.members())
+            except Exception as exc:  # noqa: BLE001 - a torn membership
+                # dir must not blank the section
+                section["members_error"] = f"{type(exc).__name__}: {exc}"
+        sessions = getattr(self.service, "_sessions", {})
+        lock = getattr(self.service, "_sessions_lock", None)
+        if lock is not None:
+            with lock:
+                section["sessions"] = sorted(
+                    f"{t}/{d}" for (t, d), s in sessions.items()
+                    if not s.closed
+                )
+        return section
 
     # -- lifecycle -------------------------------------------------------
 
@@ -69,42 +97,90 @@ class LocalWorker:
             self.membership.stop()
         self.service.close(**kw)
 
+    # -- tracing ---------------------------------------------------------
+
+    @contextmanager
+    def _span(self, name: str, trace_ctx: Optional[str], **attrs: Any):
+        """Worker-side span for one protocol call, attached for the body.
+        ``trace_ctx`` is a serialized :data:`~deequ_tpu.observability.
+        TRACE_HEADER` value from the front tier: extracting it parents
+        this span under the FRONT's trace (one trace_id across the hop);
+        without it the span joins this thread's context (in-process
+        front) or starts a new root. These spans are what a SIGKILLed
+        worker leaves behind in its journal/flight ring — a worker that
+        emitted no spans had no post-mortem."""
+        parent = "auto" if trace_ctx is None else _trace.extract(trace_ctx)
+        sp = _trace.start_span(
+            name, kind="cluster",
+            attrs={"host": self.host_id, **attrs}, parent=parent,
+        )
+        with _trace.attach(sp):
+            try:
+                yield sp
+            except BaseException as exc:
+                if sp is not _trace.NULL:
+                    sp.set_attr("error", f"{type(exc).__name__}: {exc}")
+                sp.finish("error")
+                raise
+            else:
+                sp.finish()
+
     # -- session protocol ------------------------------------------------
 
     def open_session(
-        self, tenant: str, dataset: str, checks: Sequence[Any] = (), **kw
+        self, tenant: str, dataset: str, checks: Sequence[Any] = (),
+        trace_ctx: Optional[str] = None, **kw
     ):
-        return self.service.session(tenant, dataset, checks, **kw)
+        with self._span(
+            "worker_open", trace_ctx, tenant=tenant, dataset=dataset
+        ):
+            return self.service.session(tenant, dataset, checks, **kw)
 
-    def ingest(self, tenant: str, dataset: str, data, **kw):
-        session = self.service.get_session(tenant, dataset)
-        if session is None:
-            raise KeyError(
-                f"no live session {tenant}/{dataset} on host {self.host_id}"
-            )
-        return session.ingest(data, **kw)
+    def ingest(
+        self, tenant: str, dataset: str, data,
+        trace_ctx: Optional[str] = None, **kw
+    ):
+        with self._span(
+            "worker_ingest", trace_ctx, tenant=tenant, dataset=dataset
+        ):
+            session = self.service.get_session(tenant, dataset)
+            if session is None:
+                raise KeyError(
+                    f"no live session {tenant}/{dataset} on host "
+                    f"{self.host_id}"
+                )
+            return session.ingest(data, **kw)
 
     def flush(
-        self, tenant: str, dataset: str, partition: Optional[str] = None
+        self, tenant: str, dataset: str, partition: Optional[str] = None,
+        trace_ctx: Optional[str] = None,
     ) -> Optional[str]:
         """Flush the session's cumulative states + contract into the
         shared partition store (fold boundary). Returns the partition
         name, or None when the session never folded."""
-        session = self.service.get_session(tenant, dataset)
-        if session is None:
-            return None
-        return session.flush_to_partition(partition=partition)
+        with self._span(
+            "worker_flush", trace_ctx, tenant=tenant, dataset=dataset
+        ):
+            session = self.service.get_session(tenant, dataset)
+            if session is None:
+                return None
+            return session.flush_to_partition(partition=partition)
 
-    def release(self, tenant: str, dataset: str) -> Optional[str]:
+    def release(
+        self, tenant: str, dataset: str, trace_ctx: Optional[str] = None
+    ) -> Optional[str]:
         """Flush then CLOSE the session — the outbound half of a
         migration. After release the states live in the partition store
         and this host serves 410 for the session."""
-        session = self.service.get_session(tenant, dataset)
-        if session is None:
-            return None
-        name = session.flush_to_partition()
-        session.close()
-        return name
+        with self._span(
+            "worker_release", trace_ctx, tenant=tenant, dataset=dataset
+        ):
+            session = self.service.get_session(tenant, dataset)
+            if session is None:
+                return None
+            name = session.flush_to_partition()
+            session.close()
+            return name
 
     def adopt_session(
         self,
@@ -112,6 +188,7 @@ class LocalWorker:
         dataset: str,
         checks: Sequence[Any] = (),
         partition: Optional[str] = None,
+        trace_ctx: Optional[str] = None,
         **kw,
     ):
         """Re-open a migrated/lost session from the shared partition
@@ -121,30 +198,35 @@ class LocalWorker:
         beside them (drift policies fire identically post-migration).
         A session that never flushed adopts an EMPTY provider — correct,
         because the front tier then replays every journaled fold."""
-        store = getattr(self.service, "partition_store", None)
-        if store is None:
-            raise ValueError(
-                f"host {self.host_id} has no partition store to adopt from"
-            )
-        name = partition or session_partition(tenant)
-        kw.setdefault("state_provider", store.provider(dataset, name))
-        session = self.service.session(tenant, dataset, checks, **kw)
-        if session._schema is None:
-            manifest = store.get(dataset, name)
-            if manifest is not None and manifest.schema:
-                from ..data import ColumnKind, ColumnSchema, Schema
+        with self._span(
+            "worker_adopt", trace_ctx, tenant=tenant, dataset=dataset,
+            partition=partition or session_partition(tenant),
+        ):
+            store = getattr(self.service, "partition_store", None)
+            if store is None:
+                raise ValueError(
+                    f"host {self.host_id} has no partition store to "
+                    f"adopt from"
+                )
+            name = partition or session_partition(tenant)
+            kw.setdefault("state_provider", store.provider(dataset, name))
+            session = self.service.session(tenant, dataset, checks, **kw)
+            if session._schema is None:
+                manifest = store.get(dataset, name)
+                if manifest is not None and manifest.schema:
+                    from ..data import ColumnKind, ColumnSchema, Schema
 
-                # the flushed manifest carries the schema the states were
-                # folded under: restoring it lets the adopted session
-                # serve state-only queries (current()) BEFORE its first
-                # post-adoption fold, and keeps the committed row total
-                # cumulative across the migration
-                session._schema = Schema([
-                    ColumnSchema(n, ColumnKind(k))
-                    for n, k in manifest.schema
-                ])
-                session.rows_ingested = int(manifest.num_rows)
-        return session
+                    # the flushed manifest carries the schema the states
+                    # were folded under: restoring it lets the adopted
+                    # session serve state-only queries (current()) BEFORE
+                    # its first post-adoption fold, and keeps the
+                    # committed row total cumulative across the migration
+                    session._schema = Schema([
+                        ColumnSchema(n, ColumnKind(k))
+                        for n, k in manifest.schema
+                    ])
+                    session.rows_ingested = int(manifest.num_rows)
+            return session
 
     def session_stats(self, tenant: str, dataset: str) -> dict:
         session = self.service.get_session(tenant, dataset)

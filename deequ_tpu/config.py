@@ -295,6 +295,13 @@ SCAN_DEADLINE_ENV = "DEEQU_TPU_SCAN_DEADLINE_S"
 # - DEEQU_TPU_TRACE_RING: capacity of the flight-recorder ring of recent
 #   finished spans (default 4096) — what /trace serves and what
 #   typed-failure post-mortem dumps snapshot.
+# - DEEQU_TPU_TRACE_JOURNAL: directory receiving this process's span
+#   JOURNAL (``spans-<host>.jsonl``, line-buffered, one span per line as
+#   it finishes) — the per-host half of a cross-process merged trace
+#   (observability.export.merge_journals). Unset = no journal.
+# - DEEQU_TPU_TRACE_HOST: the host label stamped on this process's
+#   journal filename and header (default ``pid<pid>``); what the merged
+#   Perfetto artifact names the process track.
 # - DEEQU_TPU_FLIGHT_DIR: directory receiving flight-record JSONL
 #   artifacts dumped on typed failures (DeviceFailure / ScanStallError /
 #   CorruptStateError / SchemaDriftError). Unset = per-process temp dir.

@@ -31,6 +31,7 @@ payload (the ``X-Deequ-Checksum`` header on the HTTP plane, the
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator, List, Optional, Sequence, Tuple, Union
 
@@ -249,8 +250,26 @@ def _fold_frames(session, frames, report: IngestReport, sp, timeout) -> None:
 
     metrics = session.service.metrics
     labels = {"tenant": session.tenant, "dataset": session.dataset}
+    decode_labels = {
+        "tenant": session.tenant,
+        "priority": getattr(
+            session.priority, "name", str(session.priority)
+        ).lower(),
+    }
+    frames = iter(frames)
     try:
-        for index, batch in frames:
+        while True:
+            # the next() pull IS the frame decode (both generators do
+            # their read_next_batch inside) — time it per frame
+            t0 = time.perf_counter()
+            try:
+                index, batch = next(frames)
+            except StopIteration:
+                break
+            metrics.observe(
+                "deequ_service_ingest_decode_seconds",
+                time.perf_counter() - t0, **decode_labels,
+            )
             data = as_dataset(batch)
             result = session.ingest(data, timeout=timeout)
             report.frames += 1
@@ -444,4 +463,9 @@ def describe_ingest_metrics(metrics) -> None:
         "deequ_service_ingest_shed_total",
         "Ingest frames shed by bounded admission (ServiceOverloaded "
         "surfaced as HTTP 429 / typed error).",
+    )
+    metrics.describe_histogram(
+        "deequ_service_ingest_decode_seconds",
+        "Arrow IPC frame decode time on the ingestion plane, per tenant "
+        "and priority class (pow2 buckets, seconds).",
     )

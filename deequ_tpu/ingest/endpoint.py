@@ -131,7 +131,37 @@ class IngestEndpoint:
         """Process one POST; returns ``(http_status, json_body)``. Never
         raises — every failure mode maps to a typed JSON error body (the
         transport layer decides whether a client is still there to read
-        it)."""
+        it).
+
+        ``X-Deequ-Trace`` (optional) carries a serialized trace context
+        from the producer: the request span — and every fold/decode span
+        under it — parents into the REMOTE trace, so a cross-process
+        ingest shows up as one trace_id end to end."""
+        from ..observability import trace as _trace
+
+        parent = _trace.extract(headers.get(_trace.TRACE_HEADER))
+        sp = _trace.start_span(
+            "ingest_request", kind="ingest", attrs={"path": path},
+            parent=parent if parent is not None else "auto",
+        )
+        with _trace.attach(sp):
+            try:
+                status, body = self._handle_post_traced(
+                    path, headers, rfile
+                )
+            except BaseException as exc:
+                if sp is not _trace.NULL:
+                    sp.set_attr("error", f"{type(exc).__name__}: {exc}")
+                sp.finish("error")
+                raise
+        if sp is not _trace.NULL:
+            sp.set_attr("status", status)
+        sp.finish("ok" if status < 400 else "error")
+        return status, body
+
+    def _handle_post_traced(
+        self, path: str, headers, rfile
+    ) -> Tuple[int, dict]:
         target = self.parse_target(path)
         if target is None:
             return 404, {"error": "not_found", "detail": (

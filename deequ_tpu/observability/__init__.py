@@ -17,14 +17,20 @@ from . import export, trace
 from .recorder import FlightRecorder, record_failure, recorder
 from .trace import (
     NULL,
+    SPAN_KINDS,
     TRACE_ENV,
+    TRACE_HEADER,
     TRACE_RING_ENV,
     Span,
+    TraceContext,
     add_event,
     attach,
     capture,
     current_span,
     enabled,
+    extract,
+    inject,
+    sampled_trace,
     span,
     start_span,
 )
@@ -33,6 +39,7 @@ __all__ = [
     "trace", "export",
     "Span", "NULL", "span", "start_span", "attach", "capture",
     "current_span", "add_event", "enabled",
+    "TraceContext", "inject", "extract", "sampled_trace",
     "FlightRecorder", "recorder", "record_failure",
-    "TRACE_ENV", "TRACE_RING_ENV",
+    "TRACE_ENV", "TRACE_RING_ENV", "TRACE_HEADER", "SPAN_KINDS",
 ]

@@ -22,18 +22,22 @@ def _span_dict(span: Any) -> Dict[str, Any]:
     return span if isinstance(span, dict) else span.to_dict()
 
 
-def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
-    """Chrome trace-event JSON object for ``spans`` (Span objects or their
-    dicts). Timestamps convert ns -> µs; unfinished spans export with zero
-    duration rather than being dropped (a crash artifact should still show
-    what was in flight)."""
-    pid = os.getpid()
+def _chrome_events(
+    span_dicts: Iterable[Dict[str, Any]],
+    pid: int,
+    offset_ns: float = 0.0,
+    extra_args: Optional[Dict[str, Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Convert span dicts to Chrome events under one ``pid`` track, with
+    an optional monotonic-clock offset (journal merging rebases each
+    process's perf-counter axis onto a shared one)."""
     events: List[Dict[str, Any]] = []
-    for raw in spans:
-        d = _span_dict(raw)
+    for d in span_dicts:
         start_ns = d["start_ns"]
         end_ns = d["end_ns"] if d["end_ns"] is not None else start_ns
         args = dict(d.get("attrs") or {})
+        if extra_args:
+            args.update(extra_args)
         args.update(
             trace_id=d["trace_id"], span_id=d["span_id"],
             parent_id=d["parent_id"], status=d.get("status", "ok"),
@@ -43,7 +47,7 @@ def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
                 "name": d["name"],
                 "cat": d.get("kind", "span"),
                 "ph": "X",
-                "ts": start_ns / 1e3,
+                "ts": (start_ns + offset_ns) / 1e3,
                 "dur": max(end_ns - start_ns, 0) / 1e3,
                 "pid": pid,
                 "tid": d.get("thread", 0),
@@ -59,12 +63,21 @@ def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
                     "cat": "event",
                     "ph": "i",
                     "s": "t",
-                    "ts": ev["ts_ns"] / 1e3,
+                    "ts": (ev["ts_ns"] + offset_ns) / 1e3,
                     "pid": pid,
                     "tid": d.get("thread", 0),
                     "args": ev_args,
                 }
             )
+    return events
+
+
+def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
+    """Chrome trace-event JSON object for ``spans`` (Span objects or their
+    dicts). Timestamps convert ns -> µs; unfinished spans export with zero
+    duration rather than being dropped (a crash artifact should still show
+    what was in flight)."""
+    events = _chrome_events((_span_dict(s) for s in spans), pid=os.getpid())
     from .trace import EPOCH_ANCHOR_S
 
     return {
@@ -75,6 +88,92 @@ def spans_to_chrome(spans: Iterable[Any]) -> Dict[str, Any]:
         # absolute seconds ~= epoch_anchor_s + ts / 1e6
         "otherData": {"epoch_anchor_s": EPOCH_ANCHOR_S},
     }
+
+
+def load_journal(path: str):
+    """Read one span-journal JSONL file. Returns ``(header, spans,
+    skipped)``: the ``journal_header`` record (or None for headerless /
+    flight-dump files), the span dicts in file order, and the count of
+    lines that did not parse (a SIGKILLed writer legitimately leaves a
+    torn final line — skipped, counted, never fatal)."""
+    header: Optional[Dict[str, Any]] = None
+    spans: List[Dict[str, Any]] = []
+    skipped = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(record, dict):
+                skipped += 1
+                continue
+            if record.get("journal_header"):
+                if header is None:
+                    header = record
+                continue
+            if record.get("flight_record"):
+                continue
+            if "span_id" not in record or "start_ns" not in record:
+                skipped += 1
+                continue
+            spans.append(record)
+    return header, spans, skipped
+
+
+def merge_journals(
+    paths: Iterable[str], out_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Merge per-host span journals into ONE Chrome/Perfetto trace object.
+
+    Each journal's timestamps are that process's ``perf_counter_ns`` axis;
+    its header's ``epoch_anchor_s`` places the axis on wall clock. The
+    merge rebases every file onto the earliest anchor, so spans from
+    different hosts line up on one timeline, one ``pid`` track per journal
+    (named after its host label). Cross-process causality needs no clock
+    at all — it rides the shared ``trace_id``/``parent_id`` in ``args``.
+    """
+    journals = []
+    for path in sorted(paths):
+        header, spans, skipped = load_journal(path)
+        if header is None:
+            header = {
+                "host": os.path.basename(path), "pid": 0,
+                "epoch_anchor_s": 0.0,
+            }
+        journals.append((header, spans, skipped, path))
+    anchors = [h.get("epoch_anchor_s", 0.0) or 0.0 for h, _, _, _ in journals]
+    base_anchor = min(anchors) if anchors else 0.0
+    events: List[Dict[str, Any]] = []
+    meta = []
+    for track, (header, spans, skipped, path) in enumerate(journals, 1):
+        host = str(header.get("host") or f"journal{track}")
+        anchor = header.get("epoch_anchor_s", 0.0) or 0.0
+        offset_ns = (anchor - base_anchor) * 1e9
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": track, "tid": 0,
+             "args": {"name": host}}
+        )
+        events.extend(
+            _chrome_events(spans, pid=track, offset_ns=offset_ns,
+                           extra_args={"host": host})
+        )
+        meta.append(
+            {"host": host, "path": path, "spans": len(spans),
+             "skipped_lines": skipped, "epoch_anchor_s": anchor}
+        )
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"epoch_anchor_s": base_anchor, "journals": meta},
+    }
+    if out_path is not None:
+        _write_atomic(out_path, json.dumps(doc))
+    return doc
 
 
 def chrome_trace_text(spans: Optional[Iterable[Any]] = None) -> str:

@@ -38,6 +38,24 @@ from typing import Any, Dict, List, Optional
 #: ``recorder().directory()`` and logged on first dump).
 FLIGHT_DIR_ENV = "DEEQU_TPU_FLIGHT_DIR"
 
+#: env var: directory receiving this process's span JOURNAL — every
+#: finished span teed as one JSONL line to ``spans-<host>.jsonl`` (after a
+#: ``journal_header`` line carrying host/pid/epoch anchor). Unset = no
+#: journal (the default: the ring alone serves single-process use; multi-
+#: host soaks set this per worker so ``export.merge_journals`` can stitch
+#: one cross-process Perfetto trace).
+TRACE_JOURNAL_ENV = "DEEQU_TPU_TRACE_JOURNAL"
+
+#: env var: logical host label stamped on journal lines and the journal
+#: filename; unset = ``pid<os.getpid()>``.
+TRACE_HOST_ENV = "DEEQU_TPU_TRACE_HOST"
+
+
+def journal_host() -> str:
+    from ..utils import env_str
+
+    return env_str(TRACE_HOST_ENV) or f"pid{os.getpid()}"
+
 #: hard cap on dump artifacts per process: beyond it, failures only count
 _MAX_DUMPS = 256
 
@@ -85,13 +103,63 @@ class FlightRecorder:
         self._dump_seq = 0
         self._dir: Optional[str] = None
         self._logged_dir = False
+        #: span-journal tee: None = env not probed yet, False = off (unset
+        #: or failed), else the open line-buffered file handle. Probed
+        #: lazily so importing the module never touches the filesystem.
+        self._journal: Any = None
+        self.journal_path: Optional[str] = None
 
     # -- span intake ---------------------------------------------------------
+
+    def _journal_handle(self):
+        """Resolve (once) and return the journal file handle, or False.
+        Caller holds ``self._lock``."""
+        if self._journal is None:
+            from ..utils import env_str
+
+            directory = env_str(TRACE_JOURNAL_ENV)
+            if not directory:
+                self._journal = False
+            else:
+                from .trace import EPOCH_ANCHOR_S
+
+                try:
+                    os.makedirs(directory, exist_ok=True)
+                    host = journal_host()
+                    path = os.path.join(directory, f"spans-{host}.jsonl")
+                    # line-buffered: each span line hits the fd as it is
+                    # written, so a SIGKILLed worker's journal still holds
+                    # everything it finished (the kill-one drill reads it)
+                    fh = open(path, "a", buffering=1)
+                    fh.write(json.dumps({
+                        "journal_header": True, "host": host,
+                        "pid": os.getpid(),
+                        "epoch_anchor_s": EPOCH_ANCHOR_S,
+                    }) + "\n")
+                    self._journal = fh
+                    self.journal_path = path
+                except Exception:  # noqa: BLE001 - journal is advisory
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "could not open span journal under %s=%r",
+                        TRACE_JOURNAL_ENV, directory, exc_info=True,
+                    )
+                    self._journal = False
+        return self._journal
 
     def on_span_finish(self, span) -> None:
         dump_for: Optional[List[Dict[str, Any]]] = None
         with self._lock:
             self._ring.append(span)
+            journal = self._journal_handle()
+            if journal is not False:
+                try:
+                    journal.write(
+                        json.dumps(span.to_dict(), default=str) + "\n"
+                    )
+                except Exception:  # noqa: BLE001 - journal is advisory
+                    self._journal = False
             # a unit-of-work span closing releases the trace's pending
             # dump: the job span (service path), verification/analysis
             # (direct-call path — a caller's long-lived outer span may
@@ -124,6 +192,16 @@ class FlightRecorder:
             self.dump_counts.clear()
             self.dump_paths.clear()
             self._dump_seq = 0
+            # re-probe the journal env on next span: tests (and soaks that
+            # re-point DEEQU_TPU_TRACE_JOURNAL between stages) rely on
+            # clear() being a full reset of the singleton
+            if self._journal not in (None, False):
+                try:
+                    self._journal.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._journal = None
+            self.journal_path = None
 
     # -- failure intake ------------------------------------------------------
 

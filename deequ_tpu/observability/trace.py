@@ -35,6 +35,7 @@ process-global flight-recorder ring (`recorder.py`).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import threading
@@ -50,6 +51,19 @@ TRACE_ENV = "DEEQU_TPU_TRACE"
 #: env var: capacity of the flight-recorder ring of finished spans
 #: (default 4096; see recorder.py).
 TRACE_RING_ENV = "DEEQU_TPU_TRACE_RING"
+
+#: HTTP header / RPC field carrying a serialized trace context across
+#: process boundaries (front -> worker hops, the Arrow ingest wire).
+TRACE_HEADER = "X-Deequ-Trace"
+
+#: the closed set of span ``kind`` literals; statlint's span-kind-registry
+#: check reads this frozenset, so a new kind MUST be registered here before
+#: any ``span(..., kind=...)`` call site can use it.
+SPAN_KINDS = frozenset({
+    "span", "phase", "job", "verification", "analysis", "engine",
+    "ingest", "stall", "cluster", "tuning", "incremental", "fleetwatch",
+    "coalesce", "rpc",
+})
 
 #: wall-clock anchor: epoch seconds at (approximately) perf-counter zero,
 #: recorded once per process so exporters can place the monotonic span
@@ -92,10 +106,6 @@ def enabled() -> bool:
 
 _IDS = itertools.count(1)
 _PID = os.getpid()
-#: root-trace counter driving the deterministic sampler (no RNG: the same
-#: process makes the same decisions in the same order, which keeps chaos
-#: drills reproducible)
-_ROOTS = itertools.count(1)
 
 _TLS = threading.local()
 
@@ -239,14 +249,20 @@ def _next_span_id() -> str:
     return f"s{_PID:x}-{next(_IDS):x}"
 
 
-def _sample_root() -> bool:
-    rate = sample_rate()
+def sampled_trace(trace_id: str, rate: Optional[float] = None) -> bool:
+    """The fractional sampler: a pure function of the trace_id, so EVERY
+    process holding the same id reaches the same verdict — a sampled trace
+    keeps all its spans across the cluster, an unsampled one keeps none
+    (no RNG, no per-process counter: cross-host propagation demands the
+    decision travel with the id itself)."""
+    if rate is None:
+        rate = sample_rate()
     if rate >= 1.0:
         return True
     if rate <= 0.0:
         return False
-    n = next(_ROOTS)
-    return int(n * rate) > int((n - 1) * rate)
+    digest = hashlib.sha256(trace_id.encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0 ** 64 < rate
 
 
 def _top():
@@ -283,10 +299,11 @@ def start_span(
     if parent is NULL or isinstance(parent, _NullSpan):
         return NULL
     if parent is None:
-        if not _sample_root():
+        trace_id = _next_trace_id()
+        if not sampled_trace(trace_id):
             return NULL
         return Span(
-            name, kind, trace_id=_next_trace_id(), span_id=_next_span_id(),
+            name, kind, trace_id=trace_id, span_id=_next_span_id(),
             parent_id=None, attrs=attrs,
         )
     return Span(
@@ -340,6 +357,62 @@ def add_event(name: str, span: Optional[Any] = None, **attrs: Any) -> None:
     if target is None:
         return
     target.add_event(name, **attrs)
+
+
+class TraceContext:
+    """The wire form of a span's identity: just enough of a remote parent
+    (``trace_id`` + parent ``span_id`` + the sampling verdict) for
+    :func:`start_span` to hang a child under a trace that began in another
+    process. Produced by :func:`extract`, serialized by :func:`inject`."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def to_header(self) -> str:
+        return f"{self.trace_id};{self.span_id};{1 if self.sampled else 0}"
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"TraceContext({self.to_header()!r})"
+
+
+def inject(span: Any = None) -> Optional[str]:
+    """Serialize the current context (or ``span``) for the
+    :data:`TRACE_HEADER` wire field. Three shapes, matching
+    :func:`extract`'s three verdicts: a live span yields
+    ``trace_id;span_id;1``; a suppressed (unsampled) context yields
+    ``;;0`` so the remote side suppresses too instead of starting a fresh
+    root for half a trace; no context at all yields ``None`` (send no
+    header — the remote side makes its own root decision)."""
+    target = span if span is not None else _top()
+    if target is None:
+        return None
+    if target is NULL or isinstance(target, _NullSpan):
+        return ";;0"
+    return f"{target.trace_id};{target.span_id};1"
+
+
+def extract(header: Optional[str]) -> Any:
+    """Parse a :data:`TRACE_HEADER` value into something usable as the
+    ``parent=`` argument of :func:`start_span`: a :class:`TraceContext`
+    (sampled remote parent), :data:`NULL` (the remote root was sampled out
+    — suppress descendants here too), or ``None`` (no/unparseable header —
+    start a fresh root). Malformed values degrade to ``None`` rather than
+    raising: a bad header must never fail the request it rode in on."""
+    if not header:
+        return None
+    parts = str(header).split(";")
+    if len(parts) != 3:
+        return None
+    trace_id, span_id, flag = (p.strip() for p in parts)
+    if flag == "0":
+        return NULL
+    if flag != "1" or not trace_id or not span_id:
+        return None
+    return TraceContext(trace_id, span_id, sampled=True)
 
 
 def record_phase(phase: str, start_ns: int, end_ns: int) -> None:

@@ -334,6 +334,25 @@ class PartitionedMetricsRepository(MetricsRepository):
                 )
         return len(kept)
 
+    def compaction_lag(self) -> Dict[str, Any]:
+        """How far behind the compactor is: loose (uncompacted) entry
+        counts per bucket. ``max_loose`` against ``threshold`` is the ops
+        signal — a bucket sitting well past the threshold means the
+        compactor cannot win the lease or keeps hitting a torn file
+        (the /statusz partition-store section surfaces this)."""
+        per_bucket: Dict[str, int] = {}
+        for bucket in self.buckets():
+            n_loose = sum(
+                1 for name in dio.list_files(self._bucket_dir(bucket))
+                if name != _COMPACTED and name.startswith("e-")
+            )
+            per_bucket[bucket] = n_loose
+        return {
+            "buckets": per_bucket,
+            "max_loose": max(per_bucket.values(), default=0),
+            "threshold": self.compact_threshold,
+        }
+
     # -- reads ---------------------------------------------------------------
 
     def buckets(self) -> List[str]:

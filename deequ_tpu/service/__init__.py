@@ -214,6 +214,115 @@ class VerificationService:
             if isinstance(catalog, str):
                 catalog = TenantCatalog(catalog, metrics=self.metrics)
             self.catalog_plane = CatalogPlane(self, catalog)
+        from .statusz import StatuszRegistry
+
+        #: the unified ops snapshot (/statusz): one plane per subsystem,
+        #: registered here so the document always covers the full closed
+        #: set — a worker process later OVERWRITES the detached "cluster"
+        #: section with its membership view (last-wins registration)
+        self.statusz = StatuszRegistry()
+        self._register_statusz_planes()
+
+    def _register_statusz_planes(self) -> None:
+        """Register the six REQUIRED_PLANES sections of the /statusz
+        document against this service's live objects. Sections read
+        through to the planes at snapshot time — never cached."""
+
+        def scheduler_section():
+            return {
+                "queue_depth": self.scheduler.pending(),
+                "active_jobs": self.scheduler._active,
+                "workers": len(self.scheduler._workers),
+                "shed_total": self.metrics.counter_value(
+                    "deequ_service_jobs_shed_total"
+                ),
+                "quota_shed_total": self.metrics.counter_value(
+                    "deequ_service_quota_shed_total"
+                ),
+                "ingest_shed_total": self.metrics.counter_value(
+                    "deequ_service_ingest_shed_total"
+                ),
+            }
+
+        def tuning_section():
+            controller = self.tuning_controller
+            if controller is None:
+                return {"enabled": False}
+            snap = controller.snapshot()
+            return {
+                "enabled": True,
+                "active_knobs": snap.get("tuned", {}),
+                "experiments": snap.get("experiments", {}),
+                "decisions": snap.get("decisions", []),
+                "floor": {
+                    "static_rate_ewma": snap.get("static_rate_ewma"),
+                    "static_samples": snap.get("static_samples"),
+                    "live_rate_ewma": snap.get("live_rate_ewma"),
+                    "live_samples": snap.get("live_samples"),
+                },
+            }
+
+        def catalog_section():
+            plane = self.catalog_plane
+            if plane is None:
+                return {"enabled": False}
+            catalog = plane.catalog
+            return {
+                "enabled": True,
+                "tenant_versions": {
+                    tenant: catalog.current_version(tenant)
+                    for tenant in catalog.tenants()
+                },
+            }
+
+        def partition_store_section():
+            store = self.partition_store
+            if store is None:
+                return {"attached": False}
+            from ..repository.partition_store import (
+                partition_quarantined_total,
+            )
+
+            section = {
+                "attached": True,
+                "path": getattr(store, "path", None),
+                "quarantined_total": partition_quarantined_total(),
+            }
+            # compaction lag lives on the metrics-HISTORY repositories
+            # (PartitionedMetricsRepository); the long-lived ones the
+            # service knows are the fleet watch's — aggregate theirs
+            lags = {}
+            with self.fleetwatch._lock:
+                repos = {
+                    f"{t}/{d}": w.repository
+                    for (t, d), w in self.fleetwatch._watches.items()
+                }
+            for key, repo in sorted(repos.items()):
+                lag_fn = getattr(repo, "compaction_lag", None)
+                if callable(lag_fn):
+                    try:
+                        lags[key] = lag_fn()
+                    except Exception:  # noqa: BLE001 - one sick repo
+                        # must not blank the whole section
+                        lags[key] = {"error": "unreadable"}
+            section["compaction_lag"] = lags
+            section["max_loose_entries"] = max(
+                (lag.get("max_loose", 0) for lag in lags.values()
+                 if isinstance(lag, dict) and "max_loose" in lag),
+                default=0,
+            )
+            return section
+
+        self.statusz.register("scheduler", scheduler_section)
+        self.statusz.register("tuning", tuning_section)
+        self.statusz.register(
+            "cluster", lambda: {"attached": False}
+        )
+        self.statusz.register("catalog", catalog_section)
+        self.statusz.register(
+            "fleetwatch", self.fleetwatch.statusz_section
+        )
+        self.statusz.register("partition_store", partition_store_section)
 
     # -- one-shot jobs -------------------------------------------------------
 
@@ -468,10 +577,11 @@ class VerificationService:
     def start_exporter(
         self, host: str = "127.0.0.1", port: int = 0, ingest: bool = True
     ) -> MetricsExporter:
-        """Serve the HTTP plane: ``/metrics`` + ``/trace`` as before, and
-        (with ``ingest=True``, the default) the Arrow IPC ingest frontend
-        at ``POST /ingest/v1/<tenant>/<dataset>`` bound to this service's
-        streaming sessions."""
+        """Serve the HTTP plane: ``/metrics`` + ``/trace`` + the unified
+        ``/statusz`` ops snapshot, and (with ``ingest=True``, the default)
+        the Arrow IPC ingest frontend at ``POST
+        /ingest/v1/<tenant>/<dataset>`` bound to this service's streaming
+        sessions."""
         if self._exporter is not None:
             if host != self._exporter.host or port not in (
                 0, self._exporter.port
@@ -490,7 +600,8 @@ class VerificationService:
 
             endpoint = IngestEndpoint(self)
         self._exporter = MetricsExporter(
-            self.metrics, host=host, port=port, ingest=endpoint
+            self.metrics, host=host, port=port, ingest=endpoint,
+            statusz=self.statusz.snapshot,
         )
         return self._exporter
 

@@ -462,6 +462,11 @@ class FoldCoalescer:
             "states, butterfly merge at the drain boundary), labeled by "
             "tenant and slice device count.",
         )
+        m.describe_histogram(
+            "deequ_service_coalesce_flush_seconds",
+            "Wall time of the coalesced drain that flushed each pending "
+            "fold, per tenant and priority class (pow2 buckets, seconds).",
+        )
 
     # -- ingest-side API -----------------------------------------------------
 
@@ -913,6 +918,7 @@ class FoldCoalescer:
     def _execute_group(self, group: List[_PendingFold]) -> None:
         from ..observability import trace as _trace
 
+        flush_t0 = time.perf_counter()
         try:
             if group[0].route == "fast":
                 if len(group) > 1:
@@ -945,6 +951,16 @@ class FoldCoalescer:
                     self._complete(f, error=RuntimeError(
                         "coalesced launch dropped a claimed fold"
                     ))
+            flush_s = time.perf_counter() - flush_t0
+            metrics = self.service.metrics
+            for f in group:
+                metrics.observe(
+                    "deequ_service_coalesce_flush_seconds", flush_s,
+                    tenant=f.skey[0],
+                    priority=getattr(
+                        f.session.priority, "name", str(f.session.priority)
+                    ).lower(),
+                )
 
     def _serial_fallback(self, pending: _PendingFold, data, pending_contract):
         """A guard outcome only the full runner can honor (drift-degraded

@@ -195,10 +195,67 @@ class FleetWatch:
             "Metric series (tenant x analyzer) under standing fleet-watch "
             "scoring.",
         )
+        from .metrics import SloEvaluator
+
+        #: latency objectives fed from the service histograms; burn rates
+        #: surface as deequ_service_slo_burn_rate{slo=...} gauges beside
+        #: the anomaly series (the fleet watch IS the alerting plane)
+        self.slo = SloEvaluator(self.metrics)
+        self.metrics.describe(
+            "deequ_service_slo_burn_rate",
+            "Error-budget burn rate per latency objective over its "
+            "window: (1 - achieved fraction) / (1 - objective), from the "
+            "service latency histogram buckets. 1 = burning exactly at "
+            "budget; >1 = objective missed if the window persists.",
+        )
+        self.watch_slo(
+            "fold_latency", "deequ_service_fold_latency_seconds",
+            threshold_s=2.0, objective=0.99,
+        )
+        self.watch_slo(
+            "admission_wait", "deequ_service_admission_wait_seconds",
+            threshold_s=0.5, objective=0.99,
+        )
 
     def _watched_series(self) -> int:
         with self._lock:
             return sum(len(w.analyzers) for w in self._watches.values())
+
+    def watch_slo(
+        self,
+        slug: str,
+        histogram: str,
+        threshold_s: float,
+        objective: float = 0.99,
+        window_s: float = 300.0,
+        **labels: str,
+    ) -> None:
+        """Register a latency objective over ``histogram`` (optionally
+        filtered to one tenant/priority via ``labels``) and surface its
+        burn rate as a ``deequ_service_slo_burn_rate{slo=...}`` gauge."""
+        self.slo.add_objective(
+            slug, histogram, threshold_s, objective, window_s, **labels
+        )
+        self.metrics.set_gauge_fn(
+            "deequ_service_slo_burn_rate",
+            lambda slug=slug: self.slo.burn_rate(slug),
+            None, slo=slug,
+        )
+
+    def statusz_section(self) -> Dict[str, Any]:
+        """The fleetwatch plane of the /statusz document."""
+        with self._lock:
+            quarantined = sorted(
+                f"{tenant}/{dataset}"
+                for tenant, dataset in self._quarantine_marks
+            )
+            watches = len(self._watches)
+        return {
+            "quarantined_sessions": quarantined,
+            "watched_series": self._watched_series(),
+            "watches": watches,
+            "slo_burn_rates": self.slo.burn_rates(),
+        }
 
     # -- registration --------------------------------------------------------
 

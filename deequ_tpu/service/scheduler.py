@@ -68,6 +68,12 @@ class Priority(enum.IntEnum):
     LOW = 2
 
 
+def _priority_label(priority: Any) -> str:
+    """Stable label value for the per-priority histogram series ("high" /
+    "normal" / "low"; raw ints degrade to their str)."""
+    return getattr(priority, "name", str(priority)).lower()
+
+
 @dataclass(frozen=True)
 class TenantQuota:
     """Per-tenant admission budget — the isolation half of multi-tenancy:
@@ -371,6 +377,17 @@ class JobScheduler:
         self.metrics.set_gauge_fn(
             "deequ_service_active_jobs", lambda: self._active,
             "Jobs currently executing on a worker.",
+        )
+        self.metrics.describe_histogram(
+            "deequ_service_admission_wait_seconds",
+            "Queue wait from submit to worker pickup, per tenant and "
+            "priority class (pow2 buckets, seconds).",
+        )
+        self.metrics.describe_histogram(
+            "deequ_service_fold_latency_seconds",
+            "End-to-end streaming fold latency (submit to terminal "
+            "outcome, serial-keyed jobs), per tenant and priority class "
+            "(pow2 buckets, seconds).",
         )
         self._workers = [
             threading.Thread(
@@ -825,6 +842,13 @@ class JobScheduler:
         job.span.add_event(
             "picked_up", worker=worker_id, attempt=job.attempts
         )
+        if job.attempts == 1:
+            # first pickup only: retries measure backoff, not admission
+            self.metrics.observe(
+                "deequ_service_admission_wait_seconds",
+                now - job.submit_time, tenant=job.tenant,
+                priority=_priority_label(job.priority),
+            )
         # fleet: lease the tenant's sub-mesh for THIS attempt — disjoint
         # from other tenants' slices, re-packed over survivors when a
         # shard dropped out of the ladder since the last attempt. The
@@ -1152,6 +1176,15 @@ class JobScheduler:
             "deequ_service_jobs_completed_total",
             tenant=job.tenant, outcome=outcome,
         )
+        if job.serial_key is not None:
+            # serial-keyed jobs ARE the streaming folds; this single
+            # terminal site covers serial, coalesced, and drain-absorbed
+            # completions alike (all funnel through _finish)
+            self.metrics.observe(
+                "deequ_service_fold_latency_seconds",
+                time.monotonic() - job.submit_time, tenant=job.tenant,
+                priority=_priority_label(job.priority),
+            )
         job.span.add_event(
             "outcome", outcome=outcome, attempts=job.attempts,
             **({"error": f"{type(error).__name__}: {str(error)[:200]}"}

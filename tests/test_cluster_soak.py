@@ -29,6 +29,20 @@ def test_two_process_soak_bit_exact_parity():
     assert report["parity_failures"] == []
     assert report["sessions_per_s"] > 0
     assert report["counters"]["deequ_service_cluster_routes_total"] > 0
+    # the observability verdict: per-host journals merged into ONE
+    # Perfetto trace, with front-tier ingest spans and worker spans
+    # sharing a trace_id across the process boundary, and a live
+    # worker's /statusz covering every plane schema-clean
+    obs = report["observability"]
+    assert obs["ok"], obs
+    # front + at least one worker journal (the ring may hash every
+    # session onto one host at small session counts)
+    assert obs["journals"] >= 2
+    assert obs["cross_process_ingest_traces"] >= 1
+    assert obs["statusz_problems"] == []
+    for plane in ("scheduler", "tuning", "cluster", "catalog",
+                  "fleetwatch", "partition_store"):
+        assert plane in obs["statusz_planes"]
 
 
 def test_kill_one_worker_recovers_with_typed_counters():
@@ -54,3 +68,10 @@ def test_kill_one_worker_recovers_with_typed_counters():
     assert counters["deequ_service_cluster_host_losses_total"] >= 1
     assert counters["deequ_service_cluster_sessions_recovered_total"] >= 1
     assert counters["deequ_service_cluster_replayed_folds_total"] >= 1
+    # satellite 1: the SIGKILLed victim's line-buffered span journal
+    # survives as its flight dump — worker-side spans for the folds it
+    # finished before dying
+    assert report["victim_journal_spans"] >= 1
+    obs = report["observability"]
+    assert obs["ok"], obs
+    assert obs["statusz_problems"] == []

@@ -89,7 +89,21 @@ FIXTURE_EXPECTATIONS = [
     ("state_algebra_bad.py", "state-algebra", "no merge()"),
     ("dead_imports_bad.py", "dead-import", "'json'"),
     ("tuning_registry_bad.py", "tuning-registry", "FIXTURE_ROUTE_MIN_ROWS"),
+    ("span_kinds_bad.py", "span-kind-registry", "freestyle_kind"),
 ]
+
+
+def test_span_kind_check_ignores_foreign_kind_kwargs():
+    """np.argsort(kind="stable") is someone else's API: the span-kind
+    check must only fire on the trace call in the fixture, never on the
+    numpy call beside it."""
+    path = _fixture("span_kinds_bad.py")
+    index = ModuleIndex([path])
+    findings = [
+        f for f in run_checks(index) if f.check == "span-kind-registry"
+    ]
+    assert len(findings) == 1, [f.message for f in findings]
+    assert findings[0].key == "kind:freestyle_kind"
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,398 @@
+"""Per-tenant SLO histograms + the unified /statusz snapshot (ISSUE 20).
+
+The acceptance contract this file pins:
+
+- the pow2-edge histogram algebra: fixed shared edges, observation
+  bucketing, MERGE BY VECTOR ADD (associative + commutative), upper-edge
+  quantiles (None on empty, +Inf in overflow) and the achieved-fraction
+  primitive the SLO evaluator runs on;
+- valid Prometheus exposition: cumulative ``_bucket{le=...}`` lines with
+  the ``+Inf`` bucket equal to ``_count``, plus ``_sum``/``_count``, all
+  under one ``# TYPE ... histogram`` header;
+- the live service observes fold latency and admission wait per
+  tenant x priority, and fleetwatch carries default burn-rate
+  objectives over both;
+- ``SloEvaluator`` burn rates: 0 when idle, 1 at exactly budget,
+  ``1/(1-objective)`` on total violation;
+- ``/statusz``: versioned document, last-wins registration, sick-plane
+  degradation to ``{"error": ...}``, ``validate_statusz`` schema gating,
+  all six ``REQUIRED_PLANES`` on a live service, and HTTP serving (404
+  on an exporter built without a statusz callable).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.service import VerificationService
+from deequ_tpu.service.metrics import (
+    HISTOGRAM_EDGES,
+    MetricsExporter,
+    ServiceMetrics,
+    SloEvaluator,
+    histogram_fraction_le,
+    histogram_quantile,
+    merge_histogram_states,
+)
+from deequ_tpu.service.statusz import (
+    PLANE_REQUIRED_KEYS,
+    REQUIRED_PLANES,
+    STATUSZ_VERSION,
+    StatuszRegistry,
+    validate_statusz,
+)
+
+pytestmark = pytest.mark.trace
+
+
+def _checks():
+    return [
+        Check(CheckLevel.ERROR, "statusz battery")
+        .has_size(lambda n: n > 0)
+        .is_complete("x")
+    ]
+
+
+def _empty_state():
+    return {
+        "counts": [0] * (len(HISTOGRAM_EDGES) + 1), "sum": 0.0, "count": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# histogram algebra
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramAlgebra:
+    def test_edges_are_shared_pow2(self):
+        assert HISTOGRAM_EDGES[0] == 2.0 ** -20
+        assert HISTOGRAM_EDGES[-1] == 64.0
+        assert all(
+            b == a * 2.0
+            for a, b in zip(HISTOGRAM_EDGES, HISTOGRAM_EDGES[1:])
+        )
+
+    def test_observe_accumulates_state(self):
+        m = ServiceMetrics()
+        for v in (0.001, 0.002, 0.004, 5.0):
+            m.observe("lat_seconds", v, tenant="a")
+        state = m.histogram_state("lat_seconds", tenant="a")
+        assert state["count"] == 4
+        assert state["sum"] == pytest.approx(5.007)
+        assert sum(state["counts"]) == 4
+
+    def test_overflow_bucket(self):
+        m = ServiceMetrics()
+        m.observe("lat_seconds", 100.0)  # past the 64 s top edge
+        state = m.histogram_state("lat_seconds")
+        assert state["counts"][-1] == 1
+
+    def test_nan_observation_dropped(self):
+        m = ServiceMetrics()
+        m.observe("lat_seconds", float("nan"))
+        assert m.histogram_state("lat_seconds") is None
+
+    def test_merge_is_commutative_vector_add(self):
+        m = ServiceMetrics()
+        m.observe("lat_seconds", 0.01, tenant="a")
+        m.observe("lat_seconds", 0.02, tenant="b")
+        m.observe("lat_seconds", 0.5, tenant="b")
+        a = m.histogram_state("lat_seconds", tenant="a")
+        b = m.histogram_state("lat_seconds", tenant="b")
+        ab = merge_histogram_states(a, b)
+        assert ab == merge_histogram_states(b, a)
+        assert ab["count"] == 3
+        assert ab["sum"] == pytest.approx(0.53)
+        assert ab["counts"] == [
+            x + y for x, y in zip(a["counts"], b["counts"])
+        ]
+        # the no-filter family merge is the same vector add
+        assert m.histogram_merged("lat_seconds") == ab
+        # label-subset filter merges only the matching cells
+        assert m.histogram_merged("lat_seconds", tenant="b")["count"] == 2
+
+    def test_quantile_is_upper_edge(self):
+        m = ServiceMetrics()
+        for _ in range(99):
+            m.observe("lat_seconds", 0.01)
+        m.observe("lat_seconds", 10.0)
+        state = m.histogram_state("lat_seconds")
+        # 0.01 s buckets under the 2^-6 edge; 10 s under the 16 s edge
+        assert histogram_quantile(state, 0.5) == 2.0 ** -6
+        assert histogram_quantile(state, 0.999) == 16.0
+
+    def test_quantile_empty_and_overflow(self):
+        assert histogram_quantile(_empty_state(), 0.99) is None
+        m = ServiceMetrics()
+        m.observe("lat_seconds", 100.0)
+        assert histogram_quantile(
+            m.histogram_state("lat_seconds"), 0.5
+        ) == float("inf")
+
+    def test_fraction_le(self):
+        m = ServiceMetrics()
+        for _ in range(9):
+            m.observe("lat_seconds", 0.01)
+        m.observe("lat_seconds", 10.0)
+        state = m.histogram_state("lat_seconds")
+        assert histogram_fraction_le(state, 1.0) == pytest.approx(0.9)
+        # no traffic violates no objective
+        assert histogram_fraction_le(_empty_state(), 1.0) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus + JSON rendering
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramRendering:
+    def test_prometheus_exposition(self):
+        m = ServiceMetrics()
+        m.describe_histogram("deequ_test_latency_seconds", "Test latency.")
+        for v in (0.001, 0.01, 0.1, 100.0):
+            m.observe("deequ_test_latency_seconds", v, tenant="a")
+        text = m.prometheus_text()
+        assert "# HELP deequ_test_latency_seconds Test latency." in text
+        assert "# TYPE deequ_test_latency_seconds histogram" in text
+        buckets = [
+            line for line in text.splitlines()
+            if line.startswith("deequ_test_latency_seconds_bucket")
+        ]
+        assert len(buckets) == len(HISTOGRAM_EDGES) + 1  # finite + +Inf
+        counts = [int(line.rsplit(" ", 1)[1]) for line in buckets]
+        assert counts == sorted(counts)  # cumulative, non-decreasing
+        assert counts[-1] == 4  # +Inf bucket == _count
+        assert buckets[-1].startswith(
+            'deequ_test_latency_seconds_bucket{tenant="a",le="+Inf"}'
+        )
+        assert 'deequ_test_latency_seconds_count{tenant="a"} 4' in text
+        assert 'deequ_test_latency_seconds_sum{tenant="a"}' in text
+
+    def test_json_snapshot_carries_histograms(self):
+        m = ServiceMetrics()
+        m.observe("lat_seconds", 0.01, tenant="a")
+        snap = m.json_snapshot()
+        state = snap["histograms"]["lat_seconds"]["tenant=a"]
+        assert state["count"] == 1
+        assert sum(state["counts"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator burn rates
+# ---------------------------------------------------------------------------
+
+
+class TestSloEvaluator:
+    def _pair(self):
+        m = ServiceMetrics()
+        slo = SloEvaluator(m)
+        slo.add_objective(
+            "lat", "lat_seconds", threshold_s=0.1, objective=0.99,
+            window_s=300.0,
+        )
+        return m, slo
+
+    def test_idle_window_is_zero(self):
+        _, slo = self._pair()
+        assert slo.burn_rate("lat", now=0.0) == 0.0
+        assert slo.burn_rate("lat", now=1.0) == 0.0
+
+    def test_all_good_zero_burn(self):
+        m, slo = self._pair()
+        slo.burn_rate("lat", now=0.0)  # baseline sample
+        for _ in range(100):
+            m.observe("lat_seconds", 0.01)
+        assert slo.burn_rate("lat", now=1.0) == 0.0
+
+    def test_total_violation_burns_at_full_rate(self):
+        m, slo = self._pair()
+        slo.burn_rate("lat", now=0.0)
+        for _ in range(10):
+            m.observe("lat_seconds", 10.0)
+        # (1 - 0) / (1 - 0.99) = 100
+        assert slo.burn_rate("lat", now=1.0) == pytest.approx(100.0)
+
+    def test_burning_exactly_at_budget_is_one(self):
+        m, slo = self._pair()
+        slo.burn_rate("lat", now=0.0)
+        for _ in range(99):
+            m.observe("lat_seconds", 0.01)
+        m.observe("lat_seconds", 10.0)
+        assert slo.burn_rate("lat", now=1.0) == pytest.approx(1.0)
+
+    def test_unknown_slug_raises(self):
+        _, slo = self._pair()
+        with pytest.raises(KeyError):
+            slo.burn_rate("nope")
+
+
+# ---------------------------------------------------------------------------
+# live service instrumentation
+# ---------------------------------------------------------------------------
+
+
+class TestServiceInstrumentation:
+    def test_fold_latency_and_admission_wait_per_tenant(self):
+        with VerificationService(
+            workers=2, background_warm=False
+        ) as svc:
+            sess = svc.session("acme", "d", _checks())
+            sess.ingest({
+                "x": np.arange(64.0), "y": np.ones(64),
+            })
+            fold = svc.metrics.histogram_merged(
+                "deequ_service_fold_latency_seconds", tenant="acme"
+            )
+            assert fold["count"] >= 1
+            wait = svc.metrics.histogram_merged(
+                "deequ_service_admission_wait_seconds", tenant="acme"
+            )
+            assert wait["count"] >= 1
+            # the cells are labeled tenant x priority
+            cells = svc.metrics.histogram_cells(
+                "deequ_service_fold_latency_seconds"
+            )
+            labels = dict(cells[0][0])
+            assert labels["tenant"] == "acme"
+            assert "priority" in labels
+
+    def test_fleetwatch_default_slo_objectives(self):
+        with VerificationService(
+            workers=1, background_warm=False
+        ) as svc:
+            slugs = svc.fleetwatch.slo.objectives()
+            assert "fold_latency" in slugs
+            assert "admission_wait" in slugs
+            rates = svc.fleetwatch.slo.burn_rates()
+            assert set(rates) == set(slugs)
+            # burn-rate gauges render on the export plane
+            text = svc.metrics.prometheus_text()
+            assert 'deequ_service_slo_burn_rate{slo="fold_latency"}' in text
+
+
+# ---------------------------------------------------------------------------
+# /statusz: registry, validation, live service, HTTP
+# ---------------------------------------------------------------------------
+
+
+def _valid_doc():
+    planes = {
+        "scheduler": {"queue_depth": 0, "active_jobs": 0, "shed_total": 0,
+                      "quota_shed_total": 0},
+        "tuning": {"enabled": False},
+        "cluster": {"attached": False},
+        "catalog": {"enabled": False},
+        "fleetwatch": {"quarantined_sessions": [], "watched_series": 0},
+        "partition_store": {"attached": False},
+    }
+    return {
+        "statusz_version": STATUSZ_VERSION,
+        "generated_unix_s": 1.0,
+        "planes": planes,
+    }
+
+
+class TestStatuszRegistry:
+    def test_snapshot_is_versioned(self):
+        reg = StatuszRegistry()
+        reg.register("tuning", lambda: {"enabled": True})
+        doc = reg.snapshot()
+        assert doc["statusz_version"] == STATUSZ_VERSION
+        assert isinstance(doc["generated_unix_s"], float)
+        assert doc["planes"]["tuning"] == {"enabled": True}
+
+    def test_registration_is_last_wins(self):
+        reg = StatuszRegistry()
+        reg.register("cluster", lambda: {"attached": False})
+        reg.register("cluster", lambda: {"attached": True, "host": "w0"})
+        assert reg.snapshot()["planes"]["cluster"]["attached"] is True
+        assert reg.planes() == ["cluster"]
+
+    def test_sick_plane_degrades_to_error_section(self):
+        reg = StatuszRegistry()
+
+        def boom():
+            raise RuntimeError("plane down")
+
+        reg.register("tuning", boom)
+        reg.register("cluster", lambda: {"attached": False})
+        doc = reg.snapshot()
+        assert doc["planes"]["tuning"] == {
+            "error": "RuntimeError: plane down"
+        }
+        # the healthy plane still reports
+        assert doc["planes"]["cluster"] == {"attached": False}
+        assert any(
+            "tuning" in p and "errored" in p
+            for p in validate_statusz(doc)
+        )
+
+
+class TestValidateStatusz:
+    def test_valid_document_passes(self):
+        assert validate_statusz(_valid_doc()) == []
+
+    def test_version_mismatch(self):
+        doc = _valid_doc()
+        doc["statusz_version"] = STATUSZ_VERSION + 1
+        assert any("statusz_version" in p for p in validate_statusz(doc))
+
+    def test_missing_plane(self):
+        doc = _valid_doc()
+        del doc["planes"]["fleetwatch"]
+        assert any("fleetwatch" in p for p in validate_statusz(doc))
+
+    def test_missing_required_key(self):
+        doc = _valid_doc()
+        del doc["planes"]["scheduler"]["queue_depth"]
+        problems = validate_statusz(doc)
+        assert any(
+            "scheduler" in p and "queue_depth" in p for p in problems
+        )
+
+    def test_every_required_plane_has_a_key_contract(self):
+        assert set(PLANE_REQUIRED_KEYS) == set(REQUIRED_PLANES)
+
+    def test_non_object_document(self):
+        assert validate_statusz(None) != []
+        assert validate_statusz([1, 2]) != []
+
+
+class TestLiveStatusz:
+    def test_service_snapshot_covers_all_planes(self):
+        with VerificationService(
+            workers=1, background_warm=False
+        ) as svc:
+            doc = svc.statusz.snapshot()
+            assert validate_statusz(doc) == []
+            assert set(REQUIRED_PLANES) <= set(doc["planes"])
+
+    def test_http_statusz_round_trip(self):
+        with VerificationService(
+            workers=1, background_warm=False
+        ) as svc:
+            exporter = svc.start_exporter()
+            url = (
+                f"http://{exporter.host}:{exporter.port}/statusz"
+            )
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                doc = json.loads(resp.read())
+            assert validate_statusz(doc) == []
+
+    def test_exporter_without_statusz_serves_404(self):
+        exporter = MetricsExporter(ServiceMetrics())
+        try:
+            url = (
+                f"http://{exporter.host}:{exporter.port}/statusz"
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url, timeout=10)
+            assert err.value.code == 404
+        finally:
+            exporter.close()

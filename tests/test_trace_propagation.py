@@ -1,0 +1,442 @@
+"""Cross-process trace propagation (ISSUE 20 tentpole).
+
+The acceptance contract this file pins:
+
+- the ``X-Deequ-Trace`` wire format round-trips through
+  ``inject``/``extract``: a remote child lands under the producer's
+  trace_id with the producer's span_id as its parent;
+- the suppression shape (``;;0``) crosses the wire: an unsampled trace
+  keeps NO spans on the remote side either (half a trace is worse than
+  none), and malformed headers degrade to a fresh root, never an error;
+- the sampling verdict is a pure function of the trace_id, so two
+  PROCESSES reading the same ``DEEQU_TPU_TRACE`` fraction reach the same
+  per-trace decision (satellite 3);
+- the HTTP ingest endpoint extracts the header and parents its request
+  span — and the folds under it — into the remote trace;
+- a cluster worker's protocol spans (``worker_open``/``worker_ingest``/
+  ``worker_flush``) join the front tier's trace via ``trace_ctx``
+  (satellite 1);
+- per-host span journals land as line-buffered JSONL with a header line,
+  and ``merge_journals`` stitches them onto ONE timeline with one pid
+  track per host;
+- ``tools/trace_summarize.py`` accounts roots vs ORPHANED spans and
+  warns when a hop dropped its context (satellite 2).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.cluster import LocalWorker
+from deequ_tpu.ingest import encode_ipc_stream
+from deequ_tpu.observability import export as obs_export
+from deequ_tpu.observability import trace
+from deequ_tpu.observability.recorder import (
+    TRACE_HOST_ENV,
+    TRACE_JOURNAL_ENV,
+    recorder,
+)
+from deequ_tpu.service import VerificationService
+from tools import trace_summarize
+
+pytestmark = pytest.mark.trace
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    recorder().clear()
+    yield
+    recorder().clear()
+
+
+def _checks():
+    return [
+        Check(CheckLevel.ERROR, "wire battery")
+        .has_size(lambda n: n > 0)
+        .is_complete("x")
+    ]
+
+
+def _payload(rows=512, seed=3):
+    rng = np.random.default_rng(seed)
+    table = pa.table({
+        "x": rng.normal(size=rows),
+        "y": rng.normal(10.0, 2.0, size=rows),
+    })
+    return encode_ipc_stream(table)
+
+
+# ---------------------------------------------------------------------------
+# wire format: inject / extract
+# ---------------------------------------------------------------------------
+
+
+class TestWireFormat:
+    def test_inject_extract_round_trip(self):
+        with trace.span("origin", kind="rpc") as origin:
+            header = trace.inject()
+            assert header == f"{origin.trace_id};{origin.span_id};1"
+        ctx = trace.extract(header)
+        assert isinstance(ctx, trace.TraceContext)
+        assert ctx.to_header() == header
+        child = trace.start_span("remote_side", kind="rpc", parent=ctx)
+        assert child.trace_id == origin.trace_id
+        assert child.parent_id == origin.span_id
+        child.finish()
+
+    def test_inject_without_context_sends_no_header(self):
+        assert trace.inject() is None
+
+    def test_explicit_span_injects_its_own_identity(self):
+        with trace.span("outer", kind="rpc") as outer:
+            with trace.span("inner", kind="rpc") as inner:
+                assert trace.inject(outer) == (
+                    f"{outer.trace_id};{outer.span_id};1"
+                )
+                assert trace.inject() == (
+                    f"{inner.trace_id};{inner.span_id};1"
+                )
+
+    def test_suppression_crosses_the_wire(self, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_ENV, "0")
+        root = trace.start_span("off", kind="rpc", parent=None)
+        assert root is trace.NULL
+        header = trace.inject(root)
+        assert header == ";;0"
+        remote_parent = trace.extract(header)
+        assert remote_parent is trace.NULL
+        # the remote side must not start a fresh root for half a trace
+        assert trace.start_span(
+            "remote", kind="rpc", parent=remote_parent
+        ) is trace.NULL
+
+    @pytest.mark.parametrize(
+        "bad",
+        [None, "", "tid;sid", "a;b;c;d", "tid;;1", ";sid;1", "tid;sid;2"],
+    )
+    def test_malformed_headers_degrade_to_fresh_root(self, bad):
+        assert trace.extract(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# deterministic fractional sampling (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestDeterministicSampling:
+    def test_verdict_is_pure_function_of_trace_id(self):
+        ids = [f"t-{i}" for i in range(256)]
+        verdicts = [trace.sampled_trace(t, 0.5) for t in ids]
+        assert verdicts == [trace.sampled_trace(t, 0.5) for t in ids]
+        # a hash sampler at 0.5 over 256 ids keeps some and drops some
+        assert any(verdicts) and not all(verdicts)
+
+    def test_rate_bounds(self):
+        assert trace.sampled_trace("anything", 1.0) is True
+        assert trace.sampled_trace("anything", 0.0) is False
+
+    def test_two_processes_agree_per_trace_id(self):
+        """Satellite 3: a SECOND python process reading the same
+        ``DEEQU_TPU_TRACE`` fraction reaches the same keep/drop verdict
+        for every trace_id — the decision travels with the id, not with
+        any per-process RNG."""
+        ids = [f"cross-{i}" for i in range(64)]
+        program = (
+            "import json, sys\n"
+            "from deequ_tpu.observability.trace import sampled_trace\n"
+            "ids = json.load(sys.stdin)\n"
+            "print(json.dumps([sampled_trace(t) for t in ids]))\n"
+        )
+        env = dict(os.environ, DEEQU_TPU_TRACE="0.5", JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", program],
+            input=json.dumps(ids), capture_output=True, text=True,
+            env=env, cwd=_REPO_ROOT, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr
+        remote = json.loads(proc.stdout.strip().splitlines()[-1])
+        local = [trace.sampled_trace(t, 0.5) for t in ids]
+        assert remote == local
+        assert any(local) and not all(local)
+
+
+# ---------------------------------------------------------------------------
+# the Arrow ingest wire: X-Deequ-Trace through the HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def service():
+    with VerificationService(
+        workers=2, max_queue_depth=64, background_warm=False
+    ) as svc:
+        yield svc
+
+
+def _post(endpoint, body, header=None):
+    headers = {"Content-Length": str(len(body))}
+    if header is not None:
+        headers[trace.TRACE_HEADER] = header
+    return endpoint.handle_post("/ingest/v1/t/d", headers, io.BytesIO(body))
+
+
+class TestEndpointPropagation:
+    def test_header_joins_remote_trace(self, service):
+        from deequ_tpu.ingest.endpoint import IngestEndpoint
+
+        service.session("t", "d", _checks())
+        endpoint = IngestEndpoint(service)
+        status, resp = _post(
+            endpoint, _payload(), header="t-producer;s-producer;1"
+        )
+        assert status == 200, resp
+        requests = [
+            s for s in recorder().spans() if s.name == "ingest_request"
+        ]
+        assert len(requests) == 1
+        assert requests[0].trace_id == "t-producer"
+        assert requests[0].parent_id == "s-producer"
+        assert requests[0].status == "ok"
+        # the fold under the request rides the REMOTE trace too: one
+        # trace_id end to end is the whole point of the wire header
+        joined = [
+            s for s in recorder().spans() if s.trace_id == "t-producer"
+        ]
+        assert len(joined) >= 2
+
+    def test_no_header_starts_fresh_root(self, service):
+        from deequ_tpu.ingest.endpoint import IngestEndpoint
+
+        service.session("t", "d", _checks())
+        endpoint = IngestEndpoint(service)
+        status, _ = _post(endpoint, _payload())
+        assert status == 200
+        requests = [
+            s for s in recorder().spans() if s.name == "ingest_request"
+        ]
+        assert len(requests) == 1
+        assert requests[0].parent_id is None
+        assert requests[0].trace_id
+
+    def test_suppressed_header_keeps_no_spans(self, service):
+        from deequ_tpu.ingest.endpoint import IngestEndpoint
+
+        service.session("t", "d", _checks())
+        endpoint = IngestEndpoint(service)
+        recorder().clear()
+        status, _ = _post(endpoint, _payload(), header=";;0")
+        assert status == 200  # suppression never affects the fold itself
+        assert [
+            s for s in recorder().spans() if s.name == "ingest_request"
+        ] == []
+
+    def test_error_status_marks_span(self, service):
+        from deequ_tpu.ingest.endpoint import IngestEndpoint
+
+        endpoint = IngestEndpoint(service)
+        body = _payload()
+        status, resp = _post(endpoint, body, header="t-err;s-err;1")
+        assert status == 404  # session never created
+        requests = [
+            s for s in recorder().spans() if s.name == "ingest_request"
+        ]
+        assert len(requests) == 1
+        assert requests[0].status == "error"
+        assert requests[0].trace_id == "t-err"
+
+
+# ---------------------------------------------------------------------------
+# worker protocol spans join the front's trace (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerSpans:
+    def _batch(self, i=0, rows=16):
+        base = float(i * rows)
+        return {
+            "x": np.arange(base, base + rows, dtype=np.float64),
+            "y": np.ones(rows, dtype=np.float64),
+        }
+
+    def test_protocol_spans_join_remote_trace(self, tmp_path):
+        svc = VerificationService(
+            workers=1, background_warm=False,
+            partition_store=str(tmp_path / "store"),
+        )
+        worker = LocalWorker("w0", svc)
+        try:
+            worker.open_session(
+                "t", "d", _checks(), trace_ctx="t-front;s-open;1"
+            )
+            worker.ingest(
+                "t", "d", self._batch(), trace_ctx="t-front;s-ingest;1"
+            )
+            worker.flush("t", "d", trace_ctx="t-front;s-flush;1")
+        finally:
+            worker.close()
+        by_name = {s.name: s for s in recorder().spans()}
+        for name, parent in (
+            ("worker_open", "s-open"),
+            ("worker_ingest", "s-ingest"),
+            ("worker_flush", "s-flush"),
+        ):
+            sp = by_name[name]
+            assert sp.trace_id == "t-front"
+            assert sp.parent_id == parent
+            assert sp.kind == "cluster"
+            assert sp.attrs["host"] == "w0"
+
+    def test_without_ctx_worker_starts_its_own_root(self):
+        svc = VerificationService(workers=1, background_warm=False)
+        worker = LocalWorker("w1", svc)
+        try:
+            worker.open_session("t", "d", _checks())
+            worker.ingest("t", "d", self._batch())
+        finally:
+            worker.close()
+        ingest = [
+            s for s in recorder().spans() if s.name == "worker_ingest"
+        ]
+        assert len(ingest) == 1
+        assert ingest[0].parent_id is None
+
+
+# ---------------------------------------------------------------------------
+# per-host span journals + the multi-host merge
+# ---------------------------------------------------------------------------
+
+
+class TestSpanJournal:
+    def test_journal_header_and_line_per_span(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(TRACE_JOURNAL_ENV, str(tmp_path))
+        monkeypatch.setenv(TRACE_HOST_ENV, "alpha")
+        recorder().clear()  # re-probe the journal env
+        with trace.span("unit_alpha", kind="span"):
+            pass
+        path = tmp_path / "spans-alpha.jsonl"
+        # line-buffered: readable without closing (the SIGKILL contract)
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert lines[0]["journal_header"] is True
+        assert lines[0]["host"] == "alpha"
+        assert "epoch_anchor_s" in lines[0]
+        assert lines[1]["name"] == "unit_alpha"
+        assert lines[1]["span_id"]
+
+    def _write_host_journals(self, tmp_path, monkeypatch):
+        for host in ("alpha", "beta"):
+            monkeypatch.setenv(TRACE_JOURNAL_ENV, str(tmp_path))
+            monkeypatch.setenv(TRACE_HOST_ENV, host)
+            recorder().clear()
+            with trace.span(f"work_{host}", kind="span"):
+                pass
+        recorder().clear()
+        journals = sorted(str(p) for p in tmp_path.glob("spans-*.jsonl"))
+        assert len(journals) == 2
+        return journals
+
+    def test_merge_journals_one_timeline(self, tmp_path, monkeypatch):
+        journals = self._write_host_journals(tmp_path, monkeypatch)
+        out = tmp_path / "merged.trace.json"
+        doc = obs_export.merge_journals(journals, out_path=str(out))
+        hosts = {
+            e["args"]["name"]
+            for e in doc["traceEvents"] if e.get("ph") == "M"
+        }
+        assert hosts == {"alpha", "beta"}
+        pids = {
+            e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"
+        }
+        assert len(pids) == 2  # one track per journal
+        assert len(doc["otherData"]["journals"]) == 2
+        # the written artifact round-trips through the summarizer loader
+        spans = trace_summarize.load_spans(str(out))
+        assert {s["name"] for s in spans} == {"work_alpha", "work_beta"}
+
+    def test_summarizer_reads_a_journal_directory(
+        self, tmp_path, monkeypatch
+    ):
+        self._write_host_journals(tmp_path, monkeypatch)
+        spans = trace_summarize.load_spans(str(tmp_path))
+        assert {s["name"] for s in spans} == {"work_alpha", "work_beta"}
+        text = trace_summarize.summarize(str(tmp_path))
+        assert "2 distinct trace_ids" in text
+
+    def test_torn_final_line_is_skipped_not_fatal(self, tmp_path):
+        path = tmp_path / "spans-torn.jsonl"
+        good = {
+            "trace_id": "t1", "span_id": "a", "parent_id": None,
+            "name": "ok", "kind": "span", "start_ns": 0, "end_ns": 5,
+            "status": "ok", "thread": 0, "attrs": {}, "events": [],
+        }
+        path.write_text(
+            json.dumps({"journal_header": True, "host": "torn", "pid": 1,
+                        "epoch_anchor_s": 0.0}) + "\n"
+            + json.dumps(good) + "\n"
+            + '{"trace_id": "t1", "span_id": "b", "star'  # SIGKILL tear
+        )
+        header, spans, skipped = obs_export.load_journal(str(path))
+        assert header["host"] == "torn"
+        assert [s["span_id"] for s in spans] == ["a"]
+        assert skipped == 1
+
+
+# ---------------------------------------------------------------------------
+# orphan accounting in the summarizer (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestOrphanAccounting:
+    def _spans(self, with_orphan=True):
+        base = {
+            "kind": "span", "status": "ok", "thread": 0,
+            "attrs": {}, "events": [],
+        }
+        spans = [
+            dict(base, trace_id="t1", span_id="a", parent_id=None,
+                 name="root", start_ns=0, end_ns=10),
+            dict(base, trace_id="t1", span_id="b", parent_id="a",
+                 name="child", start_ns=1, end_ns=5),
+        ]
+        if with_orphan:
+            spans.append(
+                dict(base, trace_id="t1", span_id="c",
+                     parent_id="missing", name="lost", start_ns=2,
+                     end_ns=4)
+            )
+        return spans
+
+    def test_span_accounting_counts(self):
+        acct = trace_summarize.span_accounting(self._spans())
+        assert acct == {
+            "total": 3, "roots": 1, "orphans": 1, "trace_ids": 1,
+        }
+
+    def test_summarize_warns_on_orphans(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        path.write_text(
+            "".join(json.dumps(s) + "\n" for s in self._spans())
+        )
+        text = trace_summarize.summarize(str(path))
+        assert "1 orphaned" in text
+        assert "WARNING: orphaned spans" in text
+
+    def test_clean_artifact_has_no_warning(self, tmp_path):
+        path = tmp_path / "spans.jsonl"
+        path.write_text(
+            "".join(
+                json.dumps(s) + "\n"
+                for s in self._spans(with_orphan=False)
+            )
+        )
+        text = trace_summarize.summarize(str(path))
+        assert "0 orphaned" in text
+        assert "WARNING" not in text

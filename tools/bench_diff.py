@@ -64,6 +64,11 @@ _SCALARS: List[Tuple[str, str, str]] = [
     ("scan", "scan_rows_per_sec_per_chip", "throughput"),
     ("ingest", "ingest_mb_per_s", "throughput"),
     ("ingest", "ingest_soak_sessions_per_s", "throughput"),
+    # per-tenant SLO histogram tails (ISSUE 20): soak fold latency and
+    # admission wait p99 must not rot (lower-better -> rss comparator);
+    # absent in runs older than the histograms — compare() skips None
+    ("ingest", "ingest_fold_latency_p99_s", "rss"),
+    ("ingest", "ingest_admission_wait_p99_s", "rss"),
     ("device_scan", "device_scan_rows_per_sec", "throughput"),
     ("grouping", "grouping_rows_per_sec", "throughput"),
     ("spill", "spill_rows_per_sec", "throughput"),

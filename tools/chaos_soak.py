@@ -188,6 +188,7 @@ def run_soak(
             from tools.tuning_report import controller_report
 
             summary["tuning_report"] = controller_report(service)
+            summary["statusz_drill"] = _statusz_drill(service)
             summary["faults_fired"] = len(injector.fired)
             snapshot = service.json_snapshot()["counters"]
             summary["device_failures_learned"] = snapshot.get(
@@ -218,6 +219,7 @@ def run_soak(
         "catalog_drill": summary["catalog_drill"]["ok"],
         "row_gate_drill": summary["row_gate_drill"]["ok"],
         "tuning_drill": summary["tuning_drill"]["ok"],
+        "statusz_drill": summary["statusz_drill"]["ok"],
     }
     if "cluster_drill" in summary:
         invariants["cluster_drill"] = summary["cluster_drill"]["ok"]
@@ -234,6 +236,27 @@ def run_soak(
             file=sys.stderr, flush=True,
         )
     return summary
+
+
+def _statusz_drill(service) -> Dict:
+    """The unified ops snapshot must stay schema-valid — and cover every
+    plane — on a service that just absorbed a whole soak's worth of
+    faults. Asserted through the public snapshot + validator, not
+    internals: exactly what an operator's probe sees."""
+    from deequ_tpu.service.statusz import REQUIRED_PLANES, validate_statusz
+
+    try:
+        doc = service.statusz.snapshot()
+        problems = validate_statusz(doc)
+    except Exception as exc:  # noqa: BLE001 - a crash IS the finding
+        return {"ok": False, "error": repr(exc)}
+    planes = sorted((doc.get("planes") or {}))
+    return {
+        "ok": not problems,
+        "planes": planes,
+        "missing_planes": sorted(set(REQUIRED_PLANES) - set(planes)),
+        "problems": problems,
+    }
 
 
 def _mesh_drill(data) -> Dict:

@@ -26,7 +26,19 @@ Two modes, both printing ONE machine-readable JSON line (exit 0 = pass,
   session is adopted from its last flushed partition and the journaled
   post-flush folds replay. The verdict asserts exact parity (no lost, no
   double-committed folds) AND the typed
-  ``deequ_service_cluster_*`` counters that prove recovery ran.
+  ``deequ_service_cluster_*`` counters that prove recovery ran — and
+  that the VICTIM's span journal is non-empty (a SIGKILLed worker still
+  leaves a worker-side flight dump behind).
+
+Both modes run with the observability plane default-ON: every process
+(front + workers) journals its spans (``DEEQU_TPU_TRACE_JOURNAL``), the
+trace context rides the ctl file-RPC (``trace`` field) and the Arrow
+ingest wire (``X-Deequ-Trace``), and after the run the per-host journals
+merge into ONE Perfetto trace (``merged.trace.json``). The verdict gates
+on a CROSS-PROCESS trace: at least one trace_id whose front-side
+``cluster_ingest`` span and worker-side spans live in different
+journals. It also fetches a worker's ``/statusz``, schema-validates it,
+and requires all six ops planes present.
 
 ``--stage-json`` is accepted for bench-stage symmetry (the JSON line is
 always printed). The worker side (``--worker I --dir D``) is internal.
@@ -143,23 +155,31 @@ def run_worker(worker_id: int, run_dir: str) -> None:
     def handle(op: dict) -> dict:
         kind = op["op"]
         tenant, dataset = op.get("tenant", ""), op.get("dataset", "")
+        # the ctl file-RPC carries the front tier's serialized trace
+        # context: the worker-side protocol span parents into the
+        # front's trace, one trace_id across the process hop
+        trace = op.get("trace")
         if kind == "open":
             worker.open_session(
-                tenant, dataset, _battery_checks(),
+                tenant, dataset, _battery_checks(), trace_ctx=trace,
                 required_analyzers=_required_analyzers(),
             )
             return {"ok": True}
         if kind == "adopt":
             worker.adopt_session(
                 tenant, dataset, _battery_checks(),
-                partition=op.get("partition") or None,
+                partition=op.get("partition") or None, trace_ctx=trace,
                 required_analyzers=_required_analyzers(),
             )
             return {"ok": True}
         if kind == "flush":
-            return {"ok": True, "partition": worker.flush(tenant, dataset)}
+            return {"ok": True,
+                    "partition": worker.flush(tenant, dataset,
+                                              trace_ctx=trace)}
         if kind == "release":
-            return {"ok": True, "partition": worker.release(tenant, dataset)}
+            return {"ok": True,
+                    "partition": worker.release(tenant, dataset,
+                                                trace_ctx=trace)}
         if kind == "stats":
             return {"ok": True, "values": session_values(tenant, dataset)}
         if kind == "stop":
@@ -251,41 +271,53 @@ class HttpWorker:
             time.sleep(0.02)
         raise TimeoutError(f"{self.host_id} did not ack {op}")
 
-    def open_session(self, tenant, dataset, checks=(), **kw):
-        self._ctl("open", tenant=tenant, dataset=dataset)
+    def open_session(self, tenant, dataset, checks=(), trace_ctx=None, **kw):
+        self._ctl("open", tenant=tenant, dataset=dataset, trace=trace_ctx)
 
-    def adopt_session(self, tenant, dataset, checks=(), partition=None, **kw):
+    def adopt_session(self, tenant, dataset, checks=(), partition=None,
+                      trace_ctx=None, **kw):
         self._ctl("adopt", tenant=tenant, dataset=dataset,
-                  partition=partition)
+                  partition=partition, trace=trace_ctx)
 
-    def flush(self, tenant, dataset, partition=None):
-        return self._ctl("flush", tenant=tenant, dataset=dataset).get(
-            "partition"
-        )
+    def flush(self, tenant, dataset, partition=None, trace_ctx=None):
+        return self._ctl("flush", tenant=tenant, dataset=dataset,
+                         trace=trace_ctx).get("partition")
 
-    def release(self, tenant, dataset):
-        return self._ctl("release", tenant=tenant, dataset=dataset).get(
-            "partition"
-        )
+    def release(self, tenant, dataset, trace_ctx=None):
+        return self._ctl("release", tenant=tenant, dataset=dataset,
+                         trace=trace_ctx).get("partition")
 
     def stats(self, tenant, dataset) -> dict:
         return self._ctl("stats", tenant=tenant, dataset=dataset).get(
             "values", {}
         )
 
-    def ingest(self, tenant, dataset, data, **kw):
+    def statusz(self) -> dict:
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self.port}/statusz"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def ingest(self, tenant, dataset, data, trace_ctx=None, **kw):
         import http.client
 
         import pyarrow as pa
 
         from deequ_tpu.ingest.arrow_stream import encode_ipc_stream
+        from deequ_tpu.observability.trace import TRACE_HEADER
 
         body = encode_ipc_stream(pa.table(data))
+        headers = {"Content-Length": str(len(body))}
+        if trace_ctx:
+            # the Arrow data plane carries the trace too: the worker's
+            # ingest_request span joins the front's trace_id
+            headers[TRACE_HEADER] = trace_ctx
         conn = http.client.HTTPConnection("127.0.0.1", self.port, timeout=60)
         try:
             conn.request(
                 "POST", f"/ingest/v1/{tenant}/{dataset}", body=body,
-                headers={"Content-Length": str(len(body))},
+                headers=headers,
             )
             resp = conn.getresponse()
             payload = resp.read()
@@ -313,18 +345,35 @@ class HttpWorker:
 # parent orchestration
 # --------------------------------------------------------------------------
 
+def _journal_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, "journal")
+
+
+def _enable_front_journal(run_dir: str) -> None:
+    """Journal the PARENT's spans (the front tier runs in this process)
+    beside the workers' — the merged artifact needs both halves of every
+    hop. Must run before the first front-tier span finishes: the flight
+    recorder probes the env once, lazily."""
+    os.makedirs(_journal_dir(run_dir), exist_ok=True)
+    os.environ["DEEQU_TPU_TRACE_JOURNAL"] = _journal_dir(run_dir)
+    os.environ["DEEQU_TPU_TRACE_HOST"] = "front"
+
+
 def _spawn_cluster(procs: int, run_dir: str):
     """Spawn worker processes; returns (popen list, HttpWorker list) or
     raises TimeoutError when the environment cannot boot them."""
     os.makedirs(os.path.join(run_dir, "ctl"), exist_ok=True)
     os.makedirs(os.path.join(run_dir, "ack"), exist_ok=True)
+    os.makedirs(_journal_dir(run_dir), exist_ok=True)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env["DEEQU_TPU_TRACE_JOURNAL"] = _journal_dir(run_dir)
     children = [
         subprocess.Popen(
             [sys.executable, "-m", "tools.cluster_soak",
              "--worker", str(i), "--dir", run_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env={**env, "DEEQU_TPU_TRACE_HOST": f"w{i}"},
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
         for i in range(procs)
@@ -399,11 +448,67 @@ def _counters(front) -> dict:
     return {n: front.metrics.counter_value(n) for n in names}
 
 
+def _observability_verdict(run_dir: str, worker) -> dict:
+    """The cross-process tentpole assertions, evaluated from artifacts —
+    not internals: merge every per-host span journal into ONE Perfetto
+    trace, demand at least one ingest whose front-side ``cluster_ingest``
+    span and worker-side spans share a trace_id across journals, and
+    schema-validate a live worker's ``/statusz`` (all six ops planes)."""
+    import glob
+
+    from deequ_tpu.observability.export import load_journal, merge_journals
+    from deequ_tpu.service.statusz import validate_statusz
+
+    journals = sorted(
+        glob.glob(os.path.join(_journal_dir(run_dir), "spans-*.jsonl"))
+    )
+    merged_path = None
+    front_ingest = set()
+    worker_traces = {}
+    hosts_by_trace = {}
+    if journals:
+        merged_path = os.path.join(run_dir, "merged.trace.json")
+        merge_journals(journals, out_path=merged_path)
+        for path in journals:
+            header, spans, _skipped = load_journal(path)
+            host = header.get("host") or os.path.basename(path)
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid:
+                    continue
+                hosts_by_trace.setdefault(tid, set()).add(host)
+                if host == "front" and s.get("name") == "cluster_ingest":
+                    front_ingest.add(tid)
+                elif host != "front":
+                    worker_traces.setdefault(tid, set()).add(host)
+    cross = [t for t, h in hosts_by_trace.items() if len(h) >= 2]
+    cross_ingest = [t for t in front_ingest if worker_traces.get(t)]
+
+    problems = []
+    planes = []
+    try:
+        doc = worker.statusz()
+        problems = validate_statusz(doc)
+        planes = sorted((doc.get("planes") or {}))
+    except Exception as exc:  # noqa: BLE001 - reported in the verdict
+        problems = [f"statusz fetch failed: {exc!r}"]
+    return {
+        "ok": bool(cross_ingest) and not problems,
+        "journals": len(journals),
+        "merged_trace": merged_path,
+        "cross_process_traces": len(cross),
+        "cross_process_ingest_traces": len(cross_ingest),
+        "statusz_planes": planes,
+        "statusz_problems": problems,
+    }
+
+
 def run_throughput(procs: int, sessions: int, batches: int,
                    rows: int) -> int:
     from concurrent.futures import ThreadPoolExecutor
 
     run_dir = tempfile.mkdtemp(prefix="cluster-soak-")
+    _enable_front_journal(run_dir)
     children = []
     try:
         try:
@@ -432,14 +537,17 @@ def run_throughput(procs: int, sessions: int, batches: int,
 
         front.flush_all()
         failures = _parity(front, sessions, batches, rows)
+        obs = _observability_verdict(run_dir, workers[0])
         report = {
-            "ok": not failures, "skipped": False, "mode": "throughput",
+            "ok": not failures and obs["ok"], "skipped": False,
+            "mode": "throughput",
             "procs": procs, "sessions": sessions, "batches": batches,
             "rows": rows, "elapsed_s": round(elapsed, 4),
             "sessions_per_s": round(sessions / elapsed, 4),
             "folds_per_s": round(sessions * batches / elapsed, 4),
             "parity_failures": failures,
             "counters": _counters(front),
+            "observability": obs,
         }
         front.close()
         print(json.dumps(report))
@@ -453,6 +561,7 @@ def run_throughput(procs: int, sessions: int, batches: int,
 
 def run_kill_one(sessions: int, batches: int, rows: int) -> int:
     run_dir = tempfile.mkdtemp(prefix="cluster-drill-")
+    _enable_front_journal(run_dir)
     children = []
     try:
         try:
@@ -513,6 +622,22 @@ def run_kill_one(sessions: int, batches: int, rows: int) -> int:
         }
         failures = _parity(front, sessions, batches, rows)
         counters = _counters(front)
+        # the worker-side flight dump: a SIGKILLed worker can't export,
+        # but its line-buffered span journal survives the kill — a
+        # victim that emitted no spans had no post-mortem
+        victim_journal = os.path.join(
+            _journal_dir(run_dir), f"spans-{victim}.jsonl"
+        )
+        victim_spans = 0
+        try:
+            with open(victim_journal, encoding="utf-8") as fh:
+                victim_spans = sum(
+                    1 for line in fh if '"span_id"' in line
+                )
+        except OSError:
+            pass
+        survivor = next(w for w in workers if w.host_id != victim)
+        obs = _observability_verdict(run_dir, survivor)
         ok = (
             not failures
             and recovered == [victim]
@@ -522,6 +647,8 @@ def run_kill_one(sessions: int, batches: int, rows: int) -> int:
             >= len(victim_sessions)
             and counters["deequ_service_cluster_replayed_folds_total"]
             >= len(victim_sessions)
+            and victim_spans >= 1
+            and obs["ok"]
         )
         report = {
             "ok": ok, "skipped": False, "mode": "kill-one",
@@ -529,6 +656,8 @@ def run_kill_one(sessions: int, batches: int, rows: int) -> int:
             "victim_sessions": len(victim_sessions), "rehomed": moved,
             "recovery_s": round(recovery_s, 3),
             "parity_failures": failures, "counters": counters,
+            "victim_journal_spans": victim_spans,
+            "observability": obs,
         }
         front.close()
         print(json.dumps(report))

@@ -172,6 +172,20 @@ def run_concurrency_soak(
             "failed_folds": failed,
             "ok": failed == 0 and done_sessions == sessions,
         })
+        # tail latency from the service's own SLO histograms (merged
+        # across tenants): what fleetwatch burn rates are computed from,
+        # surfaced here so bench_diff can regress on it
+        from deequ_tpu.service.metrics import histogram_quantile
+
+        for slug, series in (
+            ("fold_latency", "deequ_service_fold_latency_seconds"),
+            ("admission_wait", "deequ_service_admission_wait_seconds"),
+        ):
+            state = service.metrics.histogram_merged(series)
+            for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                value = histogram_quantile(state, q)
+                if value is not None and value != float("inf"):
+                    summary[f"{slug}_{tag}_s"] = round(value, 6)
     finally:
         if own_service:
             service.close()

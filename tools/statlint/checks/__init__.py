@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     export_help,
     failure_registry,
     lock_discipline,
+    span_kinds,
     state_algebra,
     trace_purity,
     tuning_registry,
@@ -22,4 +23,5 @@ ALL_CHECKS = (
     state_algebra,
     dead_imports,
     tuning_registry,
+    span_kinds,
 )

@@ -13,6 +13,8 @@ Series names are collected as STRING LITERALS matching
 built through ``inc``, ``inc_many`` tuples, list-comps and batched-update
 lists — chasing every shape is fragile; any mention of an undescribed
 series is close enough to an emission to demand the description).
+Histogram families (``ServiceMetrics.observe``) are series too:
+``describe_histogram`` marks them described, same contract as counters.
 """
 
 from __future__ import annotations
@@ -47,6 +49,11 @@ def run(index: ModuleIndex) -> List[Finding]:
                 if first is None:
                     continue
                 if name == "describe" and len(node.args) >= 2:
+                    described.add(first)
+                elif name == "describe_histogram" and (
+                    len(node.args) >= 2
+                    or any(k.arg == "help_text" for k in node.keywords)
+                ):
                     described.add(first)
                 elif name == "set_gauge_fn" and (
                     len(node.args) >= 3

@@ -16,7 +16,16 @@ opens with:
    quarantine event in the artifact, in timestamp order (the "what went
    wrong, in what order" answer).
 
-Usage: ``python -m tools.trace_summarize ARTIFACT [--top N]``.
+It also reports **span accounting**: root vs ORPHANED span counts (spans
+whose ``parent_id`` names a span missing from the artifact). Orphans are
+still summarized as effective roots, but a non-zero orphan count on a
+merged multi-host artifact is the tell of a propagation regression — a
+hop that dropped its trace context instead of carrying it.
+
+Usage: ``python -m tools.trace_summarize ARTIFACT... [--top N]``. Each
+ARTIFACT may be a Chrome trace JSON, a span JSONL file, or a DIRECTORY of
+per-host ``spans-*.jsonl`` journals (merged onto one timeline via
+:func:`deequ_tpu.observability.export.merge_journals`).
 `tools/chaos_soak.py` runs this on the trace artifact every soak leaves
 behind, so a chaos drill always ends with a readable incident summary.
 """
@@ -24,9 +33,11 @@ behind, so a chaos drill always ends with a readable incident summary.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Union
 
 #: event names that mark a degradation (kept in sync with the emitting
 #: sites in reliability/, service/ and the flight recorder)
@@ -41,10 +52,20 @@ DEGRADATION_EVENTS = frozenset(
 
 
 def load_spans(path: str) -> List[Dict[str, Any]]:
-    """Span dicts (trace.Span.to_dict shape) from either artifact format.
-    Both formats open with "{", so detection parses: a single JSON document
-    carrying ``traceEvents`` is a Chrome artifact; anything else is treated
-    as one-record-per-line JSONL (journal or flight dump)."""
+    """Span dicts (trace.Span.to_dict shape) from any artifact format.
+    A directory is a journal dir: every ``spans-*.jsonl`` inside is merged
+    onto one rebased timeline first (cross-host clock skew matters for the
+    degradation ordering). Both file formats open with "{", so detection
+    parses: a single JSON document carrying ``traceEvents`` is a Chrome
+    artifact; anything else is treated as one-record-per-line JSONL
+    (journal or flight dump)."""
+    if os.path.isdir(path):
+        from deequ_tpu.observability.export import merge_journals
+
+        journals = sorted(glob.glob(os.path.join(path, "spans-*.jsonl")))
+        if not journals:
+            return []
+        return _spans_from_chrome(merge_journals(journals))
     with open(path) as fh:
         text = fh.read()
     try:
@@ -160,6 +181,28 @@ def self_times(spans: List[Dict[str, Any]]) -> List[tuple]:
     return out
 
 
+def span_accounting(spans: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Root / orphan / trace-id counts. An ORPHAN names a parent span the
+    artifact doesn't contain — expected for a ring-evicted parent on one
+    host, but on a merged multi-host artifact a systematic orphan count
+    means a hop dropped its trace context (a propagation regression the
+    tree rendering alone would hide, since orphans still render as
+    roots)."""
+    ids = {s["span_id"] for s in spans}
+    roots = sum(1 for s in spans if s.get("parent_id") is None)
+    orphans = sum(
+        1 for s in spans
+        if s.get("parent_id") is not None and s["parent_id"] not in ids
+    )
+    traces = {s.get("trace_id") for s in spans if s.get("trace_id")}
+    return {
+        "total": len(spans),
+        "roots": roots,
+        "orphans": orphans,
+        "trace_ids": len(traces),
+    }
+
+
 def degradations(spans: List[Dict[str, Any]]) -> List[tuple]:
     """(ts_ns, owning span, event) for every degradation event, in order."""
     out = []
@@ -171,12 +214,27 @@ def degradations(spans: List[Dict[str, Any]]) -> List[tuple]:
     return out
 
 
-def summarize(path: str, top: int = 5) -> str:
-    spans = load_spans(path)
-    lines = [f"trace summary: {path} ({len(spans)} spans)"]
+def summarize(path: Union[str, Iterable[str]], top: int = 5) -> str:
+    paths = [path] if isinstance(path, str) else list(path)
+    spans: List[Dict[str, Any]] = []
+    for p in paths:
+        spans.extend(load_spans(p))
+    lines = [f"trace summary: {', '.join(paths)} ({len(spans)} spans)"]
     if not spans:
         return "\n".join(lines + ["  (empty artifact)"])
     t0 = min(s["start_ns"] for s in spans)
+
+    acct = span_accounting(spans)
+    lines.append(
+        f"span accounting: {acct['total']} spans, {acct['roots']} roots, "
+        f"{acct['orphans']} orphaned (parent not in artifact), "
+        f"{acct['trace_ids']} distinct trace_ids"
+    )
+    if acct["orphans"]:
+        lines.append(
+            "  WARNING: orphaned spans — a hop dropped its trace context "
+            "or the parent was ring-evicted"
+        )
 
     lines.append("critical path:")
     for depth, s in enumerate(critical_path(spans)):
@@ -208,7 +266,10 @@ def summarize(path: str, top: int = 5) -> str:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("artifact", help="Chrome trace JSON or span JSONL")
+    parser.add_argument(
+        "artifact", nargs="+",
+        help="Chrome trace JSON, span JSONL, or a journal directory",
+    )
     parser.add_argument("--top", type=int, default=5)
     args = parser.parse_args(argv)
     print(summarize(args.artifact, top=args.top))
